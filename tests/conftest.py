@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Tests run on XLA:CPU with 8 virtual devices so sharding/mesh code paths
+are exercised without TPU hardware (the driver's dryrun does the same).
+Must run before the first `import jax` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    """Each test gets a clean config tree and PRNG registry."""
+    from veles_tpu import config, prng
+    saved = dict(config.root.__dict__)
+    prng._streams.clear()
+    prng.seed_all(1234)
+    yield
+    config.root.__dict__.clear()
+    config.root.__dict__.update(saved)
+    prng._streams.clear()

@@ -1,0 +1,247 @@
+"""Foundation-layer tests: config tree, Bool gates, PRNG determinism,
+unit graph wiring and the workflow scheduler (SURVEY.md §7 phase 1)."""
+
+import numpy as np
+import pytest
+
+from veles_tpu.config import Config, root, parse_overrides
+from veles_tpu.mutable import Bool
+from veles_tpu import prng
+from veles_tpu.units import Unit, TrivialUnit
+from veles_tpu.workflow import Workflow, Repeater
+
+
+# -- config ------------------------------------------------------------
+
+class TestConfig:
+    def test_autovivify_and_set(self):
+        c = Config("t")
+        c.loader.minibatch_size = 60
+        assert c.loader.minibatch_size == 60
+
+    def test_update_nested(self):
+        c = Config("t")
+        c.update({"a": {"b": 1, "c": 2}, "d": 3})
+        c.update({"a": {"b": 10}})
+        assert c.a.b == 10 and c.a.c == 2 and c.d == 3
+
+    def test_override_literal_parsing(self):
+        c = Config("t")
+        c.apply_override("x.lr", "0.01")
+        c.apply_override("x.name", "hello")
+        c.apply_override("x.layers", "[1, 2]")
+        assert c.x.lr == 0.01 and c.x.name == "hello" and c.x.layers == [1, 2]
+
+    def test_parse_overrides_mutates_root(self):
+        rest = parse_overrides(["w.py", "root.loader.mb=99", "-v"])
+        assert rest == ["w.py", "-v"]
+        assert root.loader.mb == 99
+
+    def test_todict(self):
+        c = Config("t")
+        c.a.b = 1
+        assert c.todict() == {"a": {"b": 1}}
+
+
+# -- Bool gates --------------------------------------------------------
+
+class TestBool:
+    def test_value_and_assign(self):
+        b = Bool(False)
+        assert not b
+        b.set(True)
+        assert b
+        b << False
+        assert not b
+
+    def test_expression_lazy(self):
+        a, b = Bool(False), Bool(False)
+        c = a | b
+        d = ~c
+        assert not c and d
+        b.set(True)
+        assert c and not d
+
+    def test_and(self):
+        a, b = Bool(True), Bool(False)
+        assert not (a & b)
+        b.set(True)
+        assert a & b
+
+    def test_derived_not_assignable(self):
+        with pytest.raises(ValueError):
+            (~Bool()).set(True)
+
+    def test_pickle_flattens_expr(self):
+        import pickle
+        a = Bool(True)
+        c = pickle.loads(pickle.dumps(~a))
+        assert not c  # captured value at pickle time
+
+
+# -- PRNG --------------------------------------------------------------
+
+class TestPrng:
+    def test_streams_deterministic(self):
+        a1 = prng.get("weights").numpy.standard_normal(5)
+        prng.seed_all(1234)
+        a2 = prng.get("weights").numpy.standard_normal(5)
+        np.testing.assert_array_equal(a1, a2)
+
+    def test_streams_independent(self):
+        a = prng.get("a").numpy.standard_normal(5)
+        b = prng.get("b").numpy.standard_normal(5)
+        assert not np.allclose(a, b)
+
+    def test_jax_keys_deterministic(self):
+        import jax
+        s = prng.get("drop")
+        k1 = s.next_key()
+        k2 = s.next_key()
+        prng.seed_all(1234)
+        s2 = prng.get("drop")
+        assert jax.random.uniform(k1) == jax.random.uniform(s2.next_key())
+        assert jax.random.uniform(k2) == jax.random.uniform(s2.next_key())
+
+    def test_snapshot_roundtrip(self):
+        s = prng.get("x")
+        s.numpy.standard_normal(3)
+        s.next_key()
+        state = prng.snapshot_state()
+        after = s.numpy.standard_normal(3)
+        prng.restore_state(state)
+        np.testing.assert_array_equal(
+            prng.get("x").numpy.standard_normal(3), after)
+        assert prng.get("x")._key_counter == 1
+
+
+# -- unit graph + scheduler -------------------------------------------
+
+class Recorder(Unit):
+    """Appends its name to a shared trace on each run."""
+
+    def __init__(self, workflow, name, trace):
+        super().__init__(workflow, name=name)
+        self.trace = trace
+
+    def run(self):
+        self.trace.append(self.name)
+
+
+class TestWorkflowEngine:
+    def test_linear_chain(self):
+        trace = []
+        w = Workflow(name="w")
+        a = Recorder(w, "a", trace)
+        b = Recorder(w, "b", trace)
+        a.link_from(w.start_point)
+        b.link_from(a)
+        w.end_point.link_from(b)
+        w.initialize()
+        w.run()
+        assert trace == ["a", "b"]
+
+    def test_and_join(self):
+        """A unit with two predecessors fires once, after both."""
+        trace = []
+        w = Workflow(name="w")
+        a = Recorder(w, "a", trace)
+        b = Recorder(w, "b", trace)
+        c = Recorder(w, "c", trace)
+        a.link_from(w.start_point)
+        b.link_from(w.start_point)
+        c.link_from(a, b)
+        w.end_point.link_from(c)
+        w.initialize()
+        w.run()
+        assert trace[-1] == "c" and trace.count("c") == 1
+
+    def test_gate_skip_propagates(self):
+        trace = []
+        w = Workflow(name="w")
+        a = Recorder(w, "a", trace)
+        b = Recorder(w, "b", trace)
+        a.link_from(w.start_point)
+        b.link_from(a)
+        w.end_point.link_from(b)
+        a.gate_skip = Bool(True)
+        w.initialize()
+        w.run()
+        assert trace == ["b"]
+
+    def test_gate_block_stops(self):
+        trace = []
+        w = Workflow(name="w")
+        a = Recorder(w, "a", trace)
+        b = Recorder(w, "b", trace)
+        a.link_from(w.start_point)
+        b.link_from(a)
+        w.end_point.link_from(a)  # workflow still terminates
+        a_b = Bool(True)
+        b.gate_block = a_b
+        w.initialize()
+        w.run()
+        assert trace == ["a"]
+
+    def test_training_loop_shape(self):
+        """The canonical VELES loop: repeater -> body -> decision, with
+        the back edge gated by decision.complete (SURVEY.md §4.1)."""
+        trace = []
+        w = Workflow(name="w")
+        rpt = Repeater(w, name="repeater")
+        body = Recorder(w, "body", trace)
+
+        class Decision(Recorder):
+            def __init__(self, workflow, trace):
+                super().__init__(workflow, "decision", trace)
+                self.complete = Bool(False)
+
+            def run(self):
+                super().run()
+                if len([t for t in self.trace if t == "decision"]) >= 3:
+                    self.complete.set(True)
+
+        dec = Decision(w, trace)
+        rpt.link_from(w.start_point)
+        body.link_from(rpt)
+        dec.link_from(body)
+        rpt.link_from(dec)           # back edge (Repeater = OR join)
+        rpt.gate_block = dec.complete
+        w.end_point.link_from(dec)
+        w.end_point.gate_block = ~dec.complete
+        w.initialize()
+        w.run()
+        assert trace == ["body", "decision"] * 3
+
+    def test_link_attrs(self):
+        w = Workflow(name="w")
+        src = TrivialUnit(w, name="src")
+        dst = TrivialUnit(w, name="dst")
+        src.output = 42
+        dst.link_attrs(src, ("input", "output"))
+        assert dst.input == 42
+        src.output = 7
+        assert dst.input == 7
+        dst.input = 9  # two-way write-through
+        assert src.output == 9
+
+    def test_initialize_retry_on_attribute_error(self):
+        """Unit B's initialize needs A's attribute created in A's
+        initialize -> ordering resolved by the retry loop."""
+        w = Workflow(name="w")
+
+        class A(Unit):
+            def initialize(self, **kw):
+                self.out_size = 5
+
+        class B(Unit):
+            def initialize(self, **kw):
+                self.n = self.__dict__["_src"].out_size
+
+        a, b = A(w, name="a"), B(w, name="b")
+        b._src = a
+        a.link_from(w.start_point)
+        b.link_from(a)
+        w.end_point.link_from(b)
+        w.initialize()
+        assert b.n == 5

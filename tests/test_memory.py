@@ -1,0 +1,104 @@
+"""Vector coherence protocol + device backends + AcceleratedUnit seam
+(SURVEY.md §7 phase 2)."""
+
+import numpy as np
+import pytest
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.backends import JaxDevice, NumpyDevice
+from veles_tpu.memory import Vector
+
+
+@pytest.fixture(scope="module")
+def jaxdev():
+    return JaxDevice(platform="cpu")
+
+
+class TestVector:
+    def test_host_only(self):
+        v = Vector(np.arange(6, dtype=np.float32).reshape(2, 3), name="v")
+        assert v.shape == (2, 3) and v.sample_size == 3 and len(v) == 2
+        np.testing.assert_array_equal(v.map_read(), v.mem)
+
+    def test_roundtrip_through_device(self, jaxdev):
+        host = np.arange(4, dtype=np.float32)
+        v = Vector(host, name="v")
+        v.initialize(jaxdev)
+        dev = v.unmap()
+        assert dev is v.devmem
+        # simulate a device-side write: rebind devmem
+        v.devmem = dev * 2
+        np.testing.assert_array_equal(v.map_read(), host * 2)
+
+    def test_map_write_invalidates_device(self, jaxdev):
+        v = Vector(np.ones(3, np.float32), name="v")
+        v.initialize(jaxdev)
+        first_dev = v.unmap()
+        m = v.map_write()
+        m[:] = 5
+        dev = v.unmap()  # must re-upload
+        assert dev is not first_dev
+        np.testing.assert_array_equal(np.asarray(dev), [5, 5, 5])
+
+    def test_map_invalidate_no_copy_down(self, jaxdev):
+        v = Vector(np.zeros(3, np.float32), name="v")
+        v.initialize(jaxdev)
+        v.devmem = v.unmap() + 100  # device ahead of host
+        m = v.map_invalidate()      # host declares full overwrite
+        m[:] = 7
+        np.testing.assert_array_equal(np.asarray(v.unmap()), [7, 7, 7])
+
+    def test_unallocated_raises(self):
+        v = Vector(name="v")
+        with pytest.raises((RuntimeError, AttributeError)):
+            v.map_read()
+
+    def test_pickle_syncs_host(self, jaxdev):
+        import pickle
+        v = Vector(np.ones(2, np.float32), name="v")
+        v.initialize(jaxdev)
+        v.devmem = v.unmap() * 3
+        v2 = pickle.loads(pickle.dumps(v))
+        np.testing.assert_array_equal(v2.mem, [3, 3])
+        assert v2.devmem is None
+
+
+class Doubler(AcceleratedUnit):
+    """Minimal accelerated unit: out = in * 2 + p."""
+
+    def __init__(self, workflow=None, **kw):
+        super().__init__(workflow, **kw)
+        self.input = Vector(name="input")
+        self.output = Vector(name="output")
+        self.p = Vector(np.float32([10.0]), name="p")
+        self.declare_input("x", self.input)
+        self.declare_output("y", self.output)
+
+    def gather_params(self):
+        return {"p": self.p.unmap()}
+
+    def apply(self, params, inputs, rng=None):
+        return {"y": inputs["x"] * 2 + params["p"]}
+
+
+class TestAcceleratedUnit:
+    def _run(self, device):
+        u = Doubler(name="d")
+        u.input.mem = np.arange(3, dtype=np.float32)
+        u.initialize(device=device)
+        u.run()
+        return u.output.map_read()
+
+    def test_numpy_and_jax_agree(self, jaxdev):
+        out_np = self._run(NumpyDevice())
+        out_jax = self._run(jaxdev)
+        np.testing.assert_allclose(out_np, [10, 12, 14])
+        np.testing.assert_allclose(out_jax, out_np, rtol=1e-6)
+
+    def test_jax_output_stays_on_device(self, jaxdev):
+        u = Doubler(name="d")
+        u.input.mem = np.arange(3, dtype=np.float32)
+        u.initialize(device=jaxdev)
+        u.run()
+        assert u.output.devmem is not None
+        assert u.output._valid == 2  # device-only until map_read

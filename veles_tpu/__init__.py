@@ -1,0 +1,27 @@
+"""veles_tpu — a TPU-native dataflow machine-learning framework.
+
+A ground-up rebuild of the capabilities of gongqioo/veles (a fork of
+Samsung VELES; see SURVEY.md): a dataflow graph of Units composing
+Workflows, a znicz-style neural-network op set, full-batch and image
+loaders, whole-workflow snapshot/resume, a config-tree + CLI front end,
+and data-parallel distributed training — designed TPU-first on JAX/XLA:
+
+- ops are pure, traceable functions; a whole training iteration
+  (loader gather -> forwards -> evaluator -> gradient units -> weight
+  update) is fused into ONE jitted step function so XLA can fuse what
+  hand-written per-op kernels never could;
+- ``Vector`` buffers are host numpy arrays twinned with HBM
+  ``jax.Array``s under an explicit map/unmap coherence protocol
+  (reference: veles/memory.py);
+- data parallelism is an ICI allreduce (``shard_map`` + ``psum`` over a
+  ``jax.sharding.Mesh``), replacing the reference's ZeroMQ
+  master--slave aggregation (reference: veles/server.py, client.py).
+"""
+
+__version__ = "0.1.0"
+
+from veles_tpu.config import root, Config  # noqa: F401
+from veles_tpu.mutable import Bool  # noqa: F401
+from veles_tpu.units import Unit, TrivialUnit  # noqa: F401
+from veles_tpu.workflow import Workflow  # noqa: F401
+from veles_tpu.memory import Vector  # noqa: F401

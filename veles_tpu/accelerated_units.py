@@ -1,0 +1,117 @@
+"""AcceleratedUnit: the device-boundary base class.
+
+Reference parity: veles/accelerated_units.py — the base of every
+kernel-running unit.  The reference collects ``.cl``/``.cu`` sources,
+builds programs at initialize time, and dispatches ``numpy_run`` vs
+``ocl_run``/``cuda_run`` per backend, with a ``vectors_map`` of buffers
+to keep coherent.
+
+TPU-first redesign (SURVEY.md §4.3): the ``.cl``/``.cu`` seam becomes a
+pure traced function.  A subclass declares:
+
+- ``apply(self, params, inputs, rng=None) -> outputs`` — a PURE function
+  of pytrees of jax/numpy arrays, traceable by ``jax.jit`` and
+  differentiable by ``jax.vjp``.  This single definition serves four
+  consumers: the numpy backend (called eagerly with numpy arrays), the
+  per-unit jax path (jitted, for generic graphs), the fused whole-step
+  trace (ops/fused.py — the production TPU path), and autodiff (the
+  GradientDescent units call ``jax.vjp`` on it).
+- ``params_spec`` / vector declarations so the unit knows which Vectors
+  to sync around eager execution.
+
+``run()`` keeps the reference's dispatch shape: sync inputs, execute,
+leave outputs device-resident until someone ``map_read``s them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from veles_tpu.backends import Device, NumpyDevice
+from veles_tpu.memory import Vector
+from veles_tpu.units import Unit
+
+
+class AcceleratedUnit(Unit):
+    """A unit whose ``run()`` executes device compute."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.device: Optional[Device] = None
+        #: name -> Vector: buffers this unit reads (synced before run).
+        self.input_vectors: Dict[str, Vector] = {}
+        #: name -> Vector: buffers this unit writes (rebound after run).
+        self.output_vectors: Dict[str, Vector] = {}
+        self._compiled = None
+
+    # -- wiring helpers ------------------------------------------------
+
+    def declare_input(self, name: str, vector: Vector) -> Vector:
+        self.input_vectors[name] = vector
+        return vector
+
+    def declare_output(self, name: str, vector: Vector) -> Vector:
+        self.output_vectors[name] = vector
+        return vector
+
+    # -- lifecycle -----------------------------------------------------
+
+    def initialize(self, device: Optional[Device] = None, **kwargs) -> None:
+        self.device = device or NumpyDevice()
+        for v in self.input_vectors.values():
+            if v:
+                v.initialize(self.device)
+        for v in self.output_vectors.values():
+            if v:
+                v.initialize(self.device)
+
+    # -- the pure compute seam ----------------------------------------
+
+    def apply(self, params: Dict[str, Any], inputs: Dict[str, Any],
+              rng: Any = None) -> Dict[str, Any]:
+        """Pure compute: pytree in, pytree out.  MUST be traceable
+        (no Python control flow on traced values, static shapes)."""
+        raise NotImplementedError
+
+    def gather_params(self) -> Dict[str, Any]:
+        """Device-resident parameter pytree for ``apply``."""
+        return {}
+
+    def gather_inputs(self) -> Dict[str, Any]:
+        return {n: v.unmap() for n, v in self.input_vectors.items() if v}
+
+    def scatter_outputs(self, outputs: Dict[str, Any]) -> None:
+        for n, arr in outputs.items():
+            v = self.output_vectors.get(n)
+            if v is None:
+                continue
+            if self.device is not None and self.device.is_jax:
+                v.devmem = arr
+            else:
+                v.mem = arr
+
+    # -- dispatch ------------------------------------------------------
+
+    def run(self) -> None:
+        if isinstance(self.device, NumpyDevice) or self.device is None:
+            self.numpy_run()
+        else:
+            self.jax_run()
+
+    def numpy_run(self) -> None:
+        """Eager host execution of ``apply`` on numpy arrays — the
+        golden path (reference: AcceleratedUnit.numpy_run)."""
+        import numpy as np
+        params = {k: np.asarray(v) for k, v in self.gather_params().items()}
+        inputs = {k: np.asarray(v) for k, v in self.gather_inputs().items()}
+        outputs = self.apply(params, inputs)
+        self.scatter_outputs({k: np.asarray(v) for k, v in outputs.items()})
+
+    def jax_run(self) -> None:
+        """Per-unit jitted execution (generic graphs / tests).  The
+        production training path fuses all units into one step instead —
+        see veles_tpu/ops/fused.py."""
+        if self._compiled is None:
+            self._compiled = self.device.compile(self.apply)
+        outputs = self._compiled(self.gather_params(), self.gather_inputs())
+        self.scatter_outputs(outputs)

@@ -1,0 +1,132 @@
+"""Device backends.
+
+Reference parity: veles/backends.py — a ``Device`` base with OpenCL /
+CUDA / numpy engines, context management, and a per-device capability
+database for autotuned kernel block sizes.
+
+TPU-first design: two engines survive — ``NumpyDevice`` (the pure-host
+golden path, reference's "numpy backend") and ``JaxDevice`` (TPU, or
+XLA:CPU for tests).  There is no block-size autotuning database: tiling
+onto the MXU is XLA's job.  ``JaxDevice`` owns the jit cache and the
+compute dtype policy (bfloat16 matmuls by default on TPU — the MXU's
+native format — with float32 params).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from veles_tpu.logger import Logger
+
+
+class Device(Logger):
+    """Base device."""
+
+    is_jax = False
+    backend_name = "base"
+
+    def __init__(self) -> None:
+        self.compute_dtype = np.float32
+
+    def put(self, array: np.ndarray) -> Any:
+        return array
+
+    def get(self, buf: Any) -> np.ndarray:
+        return np.asarray(buf)
+
+    def compile(self, fn: Callable, **jit_kwargs: Any) -> Callable:
+        return fn
+
+    def synchronize(self) -> None:
+        pass
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__}>"
+
+
+class NumpyDevice(Device):
+    """Pure-host execution; the bit-reproducible golden backend
+    (reference: veles/backends.py NumpyDevice)."""
+
+    backend_name = "numpy"
+
+
+class JaxDevice(Device):
+    """An XLA device (TPU in production; CPU for tests/simulation).
+
+    ``compile`` wraps ``jax.jit`` with buffer donation support — donated
+    inputs are the rebind targets that give Vectors in-place update
+    semantics in HBM.
+    """
+
+    is_jax = True
+    backend_name = "jax"
+
+    def __init__(self, platform: Optional[str] = None,
+                 ordinal: int = 0, compute_dtype: Any = None) -> None:
+        super().__init__()
+        import jax
+        self._jax = jax
+        devices = jax.devices(platform) if platform else jax.devices()
+        self.jax_device = devices[ordinal]
+        self.platform = self.jax_device.platform
+        if compute_dtype is None:
+            import jax.numpy as jnp
+            compute_dtype = jnp.bfloat16 if self.platform == "tpu" \
+                else jnp.float32
+        self.compute_dtype = compute_dtype
+        self._jit_cache: Dict[Any, Callable] = {}
+
+    def put(self, array: np.ndarray) -> Any:
+        return self._jax.device_put(array, self.jax_device)
+
+    def get(self, buf: Any) -> np.ndarray:
+        return np.asarray(buf)
+
+    def compile(self, fn: Callable, **jit_kwargs: Any) -> Callable:
+        return self._jax.jit(fn, **jit_kwargs)
+
+    def cached_compile(self, key: Any, make_fn: Callable[[], Callable],
+                       **jit_kwargs: Any) -> Callable:
+        """Memoized jit: units ask for their step function by key so
+        re-initialization reuses the compiled executable."""
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self.compile(make_fn(), **jit_kwargs)
+        return self._jit_cache[key]
+
+    def synchronize(self) -> None:
+        (self._jax.device_put(0.0, self.jax_device) + 0).block_until_ready()
+
+    def __repr__(self) -> str:
+        return f"<JaxDevice {self.jax_device}>"
+
+
+class TPUDevice(JaxDevice):
+    backend_name = "tpu"
+
+    def __init__(self, ordinal: int = 0, compute_dtype: Any = None) -> None:
+        super().__init__(platform=None, ordinal=ordinal,
+                         compute_dtype=compute_dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def make_device(backend: str = "auto") -> Device:
+    """Factory: 'numpy', 'tpu'/'jax', 'cpu' (XLA:CPU), or 'auto'
+    (TPU if visible, else XLA:CPU, else numpy)."""
+    if backend == "numpy":
+        return NumpyDevice()
+    if backend in ("tpu", "jax", "auto"):
+        try:
+            import jax
+            jax.devices()
+            return JaxDevice()
+        except Exception:
+            if backend == "auto":
+                return NumpyDevice()
+            raise
+    if backend == "cpu":
+        return JaxDevice(platform="cpu")
+    raise ValueError(f"unknown backend {backend!r}")

@@ -1,0 +1,127 @@
+"""Global configuration tree.
+
+Reference parity: veles/config.py — a global ``root`` Config object with
+dot-notation attribute access (``root.loader.minibatch_size``), lazy
+auto-vivification of sub-trees, ``.update()`` from nested dicts, and CLI
+overrides of the form ``root.path.to.key=value``.
+
+Config files are plain Python modules executed for their side effect of
+mutating ``root`` (see veles_tpu/__main__.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterator
+
+
+class Config:
+    """A node in the configuration tree.
+
+    Attribute reads auto-vivify sub-Configs, so config files may write
+    ``root.a.b.c = 1`` without declaring intermediates.  Values are
+    anything; sub-trees are Config instances.
+    """
+
+    __slots__ = ("__dict__", "_name")
+
+    def __init__(self, name: str = "root", **kwargs: Any) -> None:
+        object.__setattr__(self, "_name", name)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    # -- tree behaviour ------------------------------------------------
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        child = Config(f"{self._name}.{name}")
+        self.__dict__[name] = child
+        return child
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, dict):
+            node = Config(f"{self._name}.{name}")
+            node.update(value)
+            value = node
+        self.__dict__[name] = value
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.__dict__
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.__dict__)
+
+    def __bool__(self) -> bool:
+        return bool(self.__dict__)
+
+    def __repr__(self) -> str:
+        return f"Config({self._name}: {list(self.__dict__)})"
+
+    # -- API -----------------------------------------------------------
+
+    def update(self, tree: Dict[str, Any]) -> "Config":
+        """Deep-merge a nested dict (or another Config) into this node."""
+        items = tree.__dict__.items() if isinstance(tree, Config) else tree.items()
+        for k, v in items:
+            if isinstance(v, (dict, Config)) and isinstance(
+                self.__dict__.get(k), Config
+            ):
+                self.__dict__[k].update(v)
+            else:
+                setattr(self, k, v)
+        return self
+
+    def get(self, name: str, default: Any = None) -> Any:
+        """Read without auto-vivifying."""
+        return self.__dict__.get(name, default)
+
+    def todict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, v in self.__dict__.items():
+            out[k] = v.todict() if isinstance(v, Config) else v
+        return out
+
+    def clear(self) -> None:
+        self.__dict__.clear()
+
+    def apply_override(self, dotted: str, value: str) -> None:
+        """Apply one ``path.to.key=value`` CLI override (value parsed as a
+        Python literal when possible, else kept as a string)."""
+        *path, leaf = dotted.split(".")
+        node: Config = self
+        for p in path:
+            node = getattr(node, p)
+        try:
+            parsed = ast.literal_eval(value)
+        except (ValueError, SyntaxError):
+            parsed = value
+        setattr(node, leaf, parsed)
+
+    def print_(self, indent: int = 0, file=None) -> None:
+        for k, v in sorted(self.__dict__.items()):
+            if isinstance(v, Config):
+                print("  " * indent + f"{k}:", file=file)
+                v.print_(indent + 1, file=file)
+            else:
+                print("  " * indent + f"{k} = {v!r}", file=file)
+
+
+#: The global configuration tree every workflow/config file mutates.
+root = Config("root")
+
+
+def parse_overrides(args: list) -> list:
+    """Split CLI args into (remaining, applied root.* overrides).
+
+    Any argument of the form ``root.x.y=value`` is applied to the global
+    ``root`` and removed from the list; everything else is returned.
+    """
+    remaining = []
+    for a in args:
+        if a.startswith("root.") and "=" in a:
+            dotted, _, value = a.partition("=")
+            root.apply_override(dotted[len("root."):], value)
+        else:
+            remaining.append(a)
+    return remaining

@@ -1,0 +1,39 @@
+"""Distribution hooks.
+
+Reference parity: veles/distributable.py — ``IDistributable`` defines
+the per-unit hooks the master--slave protocol calls:
+``generate_data_for_slave/master``, ``apply_data_from_slave/master``,
+``drop_slave``.
+
+TPU-first role: in the primary SPMD mode (shard_map + psum over ICI,
+see veles_tpu/parallel/) these hooks are not on the hot path — gradient
+aggregation happens inside the jitted step.  They remain the contract
+for (a) the optional zmq DCN mode for heterogeneous clusters and (b)
+multi-host job coordination (which host loads which shard).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class Distributable:
+    """Mixin with default no-op distribution hooks."""
+
+    negotiates_on_connect: bool = False
+
+    def generate_data_for_master(self) -> Any:
+        return None
+
+    def generate_data_for_slave(self, slave: Optional[Any] = None) -> Any:
+        return None
+
+    def apply_data_from_master(self, data: Any) -> None:
+        pass
+
+    def apply_data_from_slave(self, data: Any,
+                              slave: Optional[Any] = None) -> None:
+        pass
+
+    def drop_slave(self, slave: Optional[Any] = None) -> None:
+        pass

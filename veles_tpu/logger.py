@@ -1,0 +1,82 @@
+"""Logging mixin.
+
+Reference parity: veles/logger.py — a ``Logger`` mixin every unit
+inherits, giving per-instance named loggers with colored console output.
+The optional MongoDB event sink of the reference is out of scope (no
+database in the TPU environment); an in-process event hook list covers
+the same observability need.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Callable, List
+
+_COLORS = {
+    logging.DEBUG: "\033[37m",
+    logging.INFO: "\033[32m",
+    logging.WARNING: "\033[33m",
+    logging.ERROR: "\033[31m",
+    logging.CRITICAL: "\033[1;31m",
+}
+_RESET = "\033[0m"
+
+#: Hooks called with every LogRecord (the reference's MongoDB sink seam).
+event_hooks: List[Callable[[logging.LogRecord], None]] = []
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelno, "")
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+class _HookHandler(logging.Handler):
+    def emit(self, record: logging.LogRecord) -> None:
+        for hook in event_hooks:
+            hook(record)
+
+
+_configured = False
+
+
+def setup_logging(level: int = logging.INFO) -> None:
+    global _configured
+    rootlog = logging.getLogger("veles")
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            _ColorFormatter("%(asctime)s %(levelname).1s %(name)s: %(message)s",
+                            datefmt="%H:%M:%S")
+        )
+        rootlog.addHandler(handler)
+        rootlog.addHandler(_HookHandler())
+        rootlog.propagate = False
+        _configured = True
+    rootlog.setLevel(level)
+
+
+class Logger:
+    """Mixin giving ``self.info/debug/warning/error`` with a per-class
+    (or per-unit, once ``name`` exists) logger name."""
+
+    @property
+    def logger(self) -> logging.Logger:
+        name = getattr(self, "name", None) or type(self).__name__
+        return logging.getLogger(f"veles.{name}")
+
+    def debug(self, msg: str, *args) -> None:
+        self.logger.debug(msg, *args)
+
+    def info(self, msg: str, *args) -> None:
+        self.logger.info(msg, *args)
+
+    def warning(self, msg: str, *args) -> None:
+        self.logger.warning(msg, *args)
+
+    def error(self, msg: str, *args) -> None:
+        self.logger.error(msg, *args)

@@ -1,0 +1,168 @@
+"""Vector: the twin host/device buffer abstraction.
+
+Reference parity: veles/memory.py — ``Vector`` holds a numpy host array
+(``.mem``) and a device buffer (``.devmem``), kept coherent through an
+explicit protocol: ``map_read()`` (host needs to read), ``map_write()``
+(host will read+write), ``map_invalidate()`` (host will fully
+overwrite), ``unmap()`` (device needs the latest data).
+
+TPU-first design: ``devmem`` is a ``jax.Array`` in HBM.  Unlike OpenCL
+mapped pointers, JAX arrays are immutable — so "device writes" happen by
+REBINDING ``devmem`` to a step function's output (with the input buffer
+donated, giving in-place update semantics in HBM; SURVEY.md §7 "in-place
+weight updates").  The map/unmap protocol survives as the host-coherence
+contract, and its invariant checks catch stale-host-read bugs that the
+reference's assertions caught.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+HOST = 1
+DEVICE = 2
+
+
+class Vector:
+    """Host numpy array + optional device ``jax.Array``, explicitly
+    synchronized."""
+
+    def __init__(self, data: Optional[np.ndarray] = None,
+                 name: str = "") -> None:
+        self.name = name
+        self._mem: Optional[np.ndarray] = None
+        self._devmem: Any = None
+        self._valid = 0
+        self.device = None
+        if data is not None:
+            self.mem = data
+
+    # -- allocation ----------------------------------------------------
+
+    @property
+    def mem(self) -> Optional[np.ndarray]:
+        return self._mem
+
+    @mem.setter
+    def mem(self, value: Optional[np.ndarray]) -> None:
+        if value is None:
+            self._mem = None
+            self._valid = 0
+            return
+        self._mem = np.ascontiguousarray(value)
+        self._valid = HOST
+
+    def reset(self, new_mem: Optional[np.ndarray] = None) -> None:
+        self._devmem = None
+        self.mem = new_mem
+
+    def __bool__(self) -> bool:
+        return self._mem is not None or self._devmem is not None
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        if self._mem is not None:
+            return self._mem.shape
+        if self._devmem is not None:
+            return tuple(self._devmem.shape)
+        raise AttributeError(f"Vector '{self.name}' not allocated")
+
+    @property
+    def dtype(self):
+        if self._mem is not None:
+            return self._mem.dtype
+        return np.dtype(self._devmem.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape))
+
+    @property
+    def sample_size(self) -> int:
+        """Elements per leading-axis sample (reference: Vector.sample_size)."""
+        s = self.shape
+        return int(np.prod(s[1:])) if len(s) > 1 else 1
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    # -- device attach -------------------------------------------------
+
+    def initialize(self, device) -> None:
+        """Attach to a device; pushes host data to HBM on jax devices."""
+        self.device = device
+        if device is not None and device.is_jax and self._mem is not None:
+            self.unmap()
+
+    @property
+    def devmem(self) -> Any:
+        return self._devmem
+
+    @devmem.setter
+    def devmem(self, value: Any) -> None:
+        """Rebind the device buffer (a jitted step's output) and mark the
+        host copy stale — the TPU analogue of a device-side write."""
+        self._devmem = value
+        self._valid = DEVICE if value is not None else (self._valid & HOST)
+
+    # -- coherence protocol -------------------------------------------
+
+    def map_read(self) -> np.ndarray:
+        """Host is about to read: copy device->host if host is stale."""
+        if not self._valid & HOST:
+            if self._devmem is None:
+                raise RuntimeError(f"Vector '{self.name}': nothing valid")
+            self._mem = np.asarray(self._devmem)
+            self._valid |= HOST
+        return self._mem
+
+    def map_write(self) -> np.ndarray:
+        """Host will read and write: sync down, then device is stale."""
+        m = self.map_read()
+        self._valid = HOST
+        return m
+
+    def map_invalidate(self) -> np.ndarray:
+        """Host will fully overwrite: no sync down, device is stale."""
+        if self._mem is None:
+            if self._devmem is None:
+                raise RuntimeError(f"Vector '{self.name}': nothing valid")
+            self._mem = np.empty(self.shape, self.dtype)
+        self._valid = HOST
+        return self._mem
+
+    def unmap(self) -> Any:
+        """Device is about to compute: push host->device if device stale.
+        Returns the device buffer (or host mem on numpy devices)."""
+        if self.device is None or not self.device.is_jax:
+            return self._mem
+        if not self._valid & DEVICE:
+            if self._mem is None:
+                raise RuntimeError(f"Vector '{self.name}': nothing valid")
+            self._devmem = self.device.put(self._mem)
+            self._valid = HOST | DEVICE
+        return self._devmem
+
+    # -- snapshot support ---------------------------------------------
+
+    def __getstate__(self) -> dict:
+        if self._valid and not (self._valid & HOST):
+            self.map_read()
+        return {"name": self.name, "mem": self._mem}
+
+    def __setstate__(self, state: dict) -> None:
+        self.name = state["name"]
+        self._mem = state["mem"]
+        self._devmem = None
+        self.device = None
+        self._valid = HOST if self._mem is not None else 0
+
+    def __repr__(self) -> str:
+        shape = None
+        try:
+            shape = self.shape
+        except AttributeError:
+            pass
+        return f"Vector('{self.name}', shape={shape}, valid={self._valid})"
