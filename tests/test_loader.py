@@ -1,0 +1,142 @@
+"""Loader epoch/minibatch bookkeeping, shuffling determinism,
+normalizers, synthetic datasets (SURVEY.md §7 phase 3)."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.loader import ArrayLoader, TRAIN, VALID, TEST
+from veles_tpu.normalization import make_normalizer
+from veles_tpu import datasets
+
+
+def make_loader(n_train=10, n_valid=4, mb=4, **kw):
+    x = np.arange(n_train * 3, dtype=np.float32).reshape(n_train, 3)
+    y = np.arange(n_train, dtype=np.int32) % 2
+    vx = -np.arange(n_valid * 3, dtype=np.float32).reshape(n_valid, 3)
+    vy = np.arange(n_valid, dtype=np.int32) % 2
+    ld = ArrayLoader(train=(x, y), valid=(vx, vy),
+                     minibatch_size=mb, **kw)
+    ld.initialize(device=None)
+    return ld
+
+
+class TestLoader:
+    def test_split_layout(self):
+        ld = make_loader()
+        assert ld.class_lengths == [0, 4, 10]
+        assert ld.class_offset(TRAIN) == 4
+        assert ld.total_samples == 14
+
+    def test_epoch_walks_valid_then_train(self):
+        ld = make_loader(shuffle=False)
+        classes, sizes = [], []
+        for _ in range(4):  # 1 valid mb + 3 train mbs (10/4 -> 4,4,2)
+            ld.run()
+            classes.append(ld.minibatch_class)
+            sizes.append(ld.current_minibatch_size)
+        assert classes == [VALID, TRAIN, TRAIN, TRAIN]
+        assert sizes == [4, 4, 4, 2]
+        assert bool(ld.epoch_ended) and bool(ld.last_minibatch)
+        assert ld.epoch_number == 1
+
+    def test_remainder_padding_and_mask(self):
+        ld = make_loader(shuffle=False)
+        for _ in range(4):
+            ld.run()
+        mask = ld.minibatch_mask.map_read()
+        np.testing.assert_array_equal(mask, [1, 1, 0, 0])
+        # padded rows hold wrapped indices but mask excludes them
+        assert ld.minibatch_indices.map_read().shape == (4,)
+
+    def test_fill_minibatch_content(self):
+        ld = make_loader(shuffle=False)
+        ld.run()  # first valid minibatch
+        got = ld.minibatch_data.map_read()
+        np.testing.assert_array_equal(got, ld.original_data.mem[:4])
+
+    def test_shuffle_deterministic_and_reshuffled(self):
+        ld = make_loader(shuffle=True)
+        order1 = ld._order[TRAIN].copy()
+        prng.seed_all(1234)
+        ld2 = make_loader(shuffle=True)
+        np.testing.assert_array_equal(order1, ld2._order[TRAIN])
+        # next epoch must use a different permutation
+        for _ in range(4):
+            ld2.run()
+        assert not np.array_equal(order1, ld2._order[TRAIN])
+
+    def test_train_only(self):
+        x = np.zeros((6, 2), np.float32)
+        y = np.zeros(6, np.int32)
+        ld = ArrayLoader(train=(x, y), minibatch_size=3)
+        ld.initialize(device=None)
+        ld.run()
+        assert ld.minibatch_class == TRAIN
+
+    def test_autoencoder_targets(self):
+        x = np.random.default_rng(0).random((6, 2)).astype(np.float32)
+        ld = ArrayLoader(train=(x, None), targets_from_labels=True,
+                         minibatch_size=3, shuffle=False)
+        ld.initialize(device=None)
+        ld.run()
+        np.testing.assert_array_equal(ld.minibatch_targets.mem,
+                                      ld.minibatch_data.map_read())
+
+
+class TestNormalizers:
+    def test_linear(self):
+        n = make_normalizer("linear")
+        x = np.float32([[0.0], [5.0], [10.0]])
+        out = n.fit(x).apply(x)
+        np.testing.assert_allclose(out, [[-1], [0], [1]])
+
+    def test_mean_disp(self):
+        rng = np.random.default_rng(0)
+        x = rng.random((100, 5)).astype(np.float32) * 7 + 3
+        out = make_normalizer("mean_disp").fit(x).apply(x)
+        np.testing.assert_allclose(out.mean(0), 0, atol=1e-5)
+        np.testing.assert_allclose(out.std(0), 1, atol=1e-4)
+
+    def test_external_mean(self):
+        x = np.ones((4, 2, 2), np.float32)
+        n = make_normalizer("external_mean", mean=np.ones((2, 2)))
+        np.testing.assert_allclose(n.apply(x), 0)
+
+    def test_pointwise(self):
+        x = np.float32([[0, 10], [4, 20]])
+        out = make_normalizer("pointwise").fit(x).apply(x)
+        np.testing.assert_allclose(out, [[-1, -1], [1, 1]])
+
+
+class TestSyntheticDatasets:
+    def test_deterministic(self):
+        (x1, y1), _, _ = datasets.mnist(200, 50, force_synthetic=True)
+        (x2, y2), _, _ = datasets.mnist(200, 50, force_synthetic=True)
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(y1, y2)
+
+    def test_shapes_and_ranges(self):
+        (x, y), (vx, vy), _ = datasets.mnist(100, 20, force_synthetic=True)
+        assert x.shape == (100, 28, 28, 1) and y.shape == (100,)
+        assert vx.shape == (20, 28, 28, 1)
+        assert 0 <= x.min() and x.max() <= 1
+        assert set(np.unique(y)) <= set(range(10))
+
+    def test_cifar_shape(self):
+        (x, y), _, _ = datasets.cifar10(50, 10)
+        assert x.shape == (50, 32, 32, 3)
+
+    def test_learnable(self):
+        """A linear classifier on raw pixels must beat chance easily —
+        guards against an unlearnable generator."""
+        (x, y), (vx, vy), _ = datasets.mnist(2000, 400,
+                                             force_synthetic=True)
+        xf = x.reshape(len(x), -1)
+        vxf = vx.reshape(len(vx), -1)
+        # one-hot ridge regression
+        onehot = np.eye(10, dtype=np.float32)[y]
+        A = xf.T @ xf + 10.0 * np.eye(xf.shape[1], dtype=np.float32)
+        W = np.linalg.solve(A, xf.T @ onehot)
+        acc = (vxf @ W).argmax(1) == vy
+        assert acc.mean() > 0.9, acc.mean()

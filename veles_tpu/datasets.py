@@ -1,0 +1,152 @@
+"""Dataset acquisition: real files when present, deterministic synthetic
+generators otherwise.
+
+This environment has no network and ships no datasets (verified:
+full-disk search found none), so the five BASELINE.json benchmark
+configs run on procedurally generated stand-ins by default.  Each
+generator is fully determined by (seed, sizes): per-class template
+patterns plus per-sample jitter/noise, linearly separable enough that
+the reference architectures reach their target accuracies, while
+keeping realistic shapes (28x28x1, 32x32x3, 227x227x3).
+
+If real data is placed under ``root.common.data_dir`` (default
+``~/.veles_tpu/data``) — e.g. MNIST IDX files — the loaders pick it up
+instead (reference behaviour: veles/loader downloads/caches datasets;
+offline here, so files must be pre-placed).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+Split = Tuple[np.ndarray, np.ndarray]
+
+
+def data_dir() -> str:
+    from veles_tpu.config import root
+    d = root.common.get("data_dir") if "common" in root else None
+    return os.path.expanduser(d or "~/.veles_tpu/data")
+
+
+# -- real MNIST (IDX format), if files are pre-placed ------------------
+
+_MNIST_FILES = {
+    "train_images": "train-images-idx3-ubyte",
+    "train_labels": "train-labels-idx1-ubyte",
+    "test_images": "t10k-images-idx3-ubyte",
+    "test_labels": "t10k-labels-idx1-ubyte",
+}
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+
+def try_load_real_mnist() -> Optional[Tuple[Split, Split]]:
+    base = os.path.join(data_dir(), "mnist")
+    paths = {}
+    for key, fname in _MNIST_FILES.items():
+        for cand in (os.path.join(base, fname),
+                     os.path.join(base, fname + ".gz")):
+            if os.path.exists(cand):
+                paths[key] = cand
+                break
+        else:
+            return None
+    tx = _read_idx(paths["train_images"]).astype(np.float32) / 255.0
+    ty = _read_idx(paths["train_labels"]).astype(np.int32)
+    vx = _read_idx(paths["test_images"]).astype(np.float32) / 255.0
+    vy = _read_idx(paths["test_labels"]).astype(np.int32)
+    return (tx[..., None], ty), (vx[..., None], vy)
+
+
+# -- synthetic generators ----------------------------------------------
+
+def _class_templates(rng: np.random.Generator, n_classes: int,
+                     shape: Tuple[int, ...]) -> np.ndarray:
+    """Smooth per-class patterns: low-frequency random fields, so
+    convnets with pooling can exploit spatial structure."""
+    h, w = shape[0], shape[1]
+    c = shape[2] if len(shape) > 2 else 1
+    coarse = rng.standard_normal((n_classes, max(2, h // 4),
+                                  max(2, w // 4), c)).astype(np.float32)
+    # bilinear upsample to full resolution
+    out = np.empty((n_classes, h, w, c), np.float32)
+    ys = np.linspace(0, coarse.shape[1] - 1, h)
+    xs = np.linspace(0, coarse.shape[2] - 1, w)
+    y0 = np.floor(ys).astype(int); y1 = np.minimum(y0 + 1, coarse.shape[1] - 1)
+    x0 = np.floor(xs).astype(int); x1 = np.minimum(x0 + 1, coarse.shape[2] - 1)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+    out = (coarse[:, y0][:, :, x0] * (1 - wy) * (1 - wx)
+           + coarse[:, y1][:, :, x0] * wy * (1 - wx)
+           + coarse[:, y0][:, :, x1] * (1 - wy) * wx
+           + coarse[:, y1][:, :, x1] * wy * wx)
+    return out.astype(np.float32)
+
+
+def synthetic_classification(
+        n_train: int, n_valid: int, shape: Tuple[int, ...],
+        n_classes: int = 10, noise: float = 0.4, max_shift: int = 2,
+        seed: int = 20260729, n_test: int = 0,
+) -> Tuple[Split, Split, Optional[Split]]:
+    """Deterministic image-classification task.
+
+    sample = circular-shifted class template + gaussian noise, values
+    squashed to [0, 1].  Returns (train, valid, test-or-None).
+    """
+    rng = np.random.default_rng(seed)
+    templates = _class_templates(rng, n_classes, shape)
+
+    def make(n: int) -> Split:
+        y = rng.integers(0, n_classes, n).astype(np.int32)
+        x = templates[y]
+        if max_shift > 0:
+            sh, sw = (rng.integers(-max_shift, max_shift + 1, (2, n)))
+            for i in range(n):  # per-sample circular shift
+                x[i] = np.roll(x[i], (sh[i], sw[i]), axis=(0, 1))
+        x = x + noise * rng.standard_normal(x.shape).astype(np.float32)
+        x = 1.0 / (1.0 + np.exp(-x))  # squash into (0,1) like pixel data
+        if len(shape) == 2:
+            x = x[..., 0] if x.shape[-1] == 1 else x
+        return x.astype(np.float32), y
+
+    train = make(n_train)
+    valid = make(n_valid)
+    test = make(n_test) if n_test else None
+    return train, valid, test
+
+
+def mnist(n_train: int = 60000, n_valid: int = 10000,
+          force_synthetic: bool = False):
+    """MNIST: real IDX files if present, else synthetic 28x28x1."""
+    if not force_synthetic:
+        real = try_load_real_mnist()
+        if real is not None:
+            return real[0], real[1], None
+    return synthetic_classification(
+        n_train, n_valid, (28, 28, 1), n_classes=10, seed=28281)
+
+
+def cifar10(n_train: int = 50000, n_valid: int = 10000):
+    return synthetic_classification(
+        n_train, n_valid, (32, 32, 3), n_classes=10, noise=0.5, seed=32323)
+
+
+def imagenet(n_train: int = 8192, n_valid: int = 1024,
+             image_size: int = 227, n_classes: int = 1000):
+    """Synthetic ImageNet stand-in at AlexNet's input resolution.  Sizes
+    default small — the benchmark measures images/sec, not accuracy."""
+    return synthetic_classification(
+        n_train, n_valid, (image_size, image_size, 3),
+        n_classes=n_classes, noise=0.5, max_shift=8, seed=227227)

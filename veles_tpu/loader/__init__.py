@@ -1,0 +1,6 @@
+"""Data loading layer (reference: veles/loader/)."""
+
+from veles_tpu.loader.base import Loader, TEST, VALID, TRAIN, CLASS_NAMES  # noqa: F401
+from veles_tpu.loader.fullbatch import (  # noqa: F401
+    FullBatchLoader, ArrayLoader,
+)

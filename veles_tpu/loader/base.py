@@ -1,0 +1,187 @@
+"""Loader base: dataset splits, epochs, minibatch bookkeeping.
+
+Reference parity: veles/loader/base.py — ``Loader`` manages the
+TEST=0 / VALID=1 / TRAIN=2 split, assembles minibatches, shuffles the
+train set each epoch through a named PRNG stream, and raises
+``last_minibatch`` / ``epoch_ended`` flags that Decision keys off.
+It is Distributable: in the reference's master--slave mode the master
+serves minibatch indices to slaves.
+
+TPU-first design: the loader's job on the hot path is to produce
+**indices only** — the actual gather (``dataset[indices]``) happens
+on-device inside the fused jitted step, so minibatch assembly costs one
+HBM gather instead of a host->device copy per step.  The host-side
+``fill_minibatch`` path still exists for the numpy backend and generic
+units.  Epochs with a remainder minibatch are handled by padding the
+index array to the static ``max_minibatch_size`` (XLA needs static
+shapes) and masking padded rows out of the loss/metrics via
+``minibatch_mask``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.distributable import Distributable
+from veles_tpu.memory import Vector
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAMES = ("test", "validation", "train")
+
+
+class Loader(Unit, Distributable):
+    """Abstract loader.
+
+    Subclasses implement ``load_data()`` (set ``class_lengths``) and
+    ``fill_minibatch()`` (populate ``minibatch_data``/``labels`` for the
+    current indices) — same contract as the reference.
+    """
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.minibatch_size = kwargs.get("minibatch_size", 100)
+        #: samples per split: [n_test, n_valid, n_train]
+        self.class_lengths: List[int] = [0, 0, 0]
+        self.shuffle_enabled = kwargs.get("shuffle", True)
+        self.prng_stream = kwargs.get("prng_stream", "loader")
+
+        # current-minibatch state
+        self.minibatch_class = TRAIN
+        self.minibatch_offset = 0          # offset within current class
+        self.current_minibatch_size = 0    # un-padded size
+        self.minibatch_data = Vector(name="minibatch_data")
+        self.minibatch_labels = Vector(name="minibatch_labels")
+        self.minibatch_indices = Vector(name="minibatch_indices")
+        self.minibatch_mask = Vector(name="minibatch_mask")
+
+        # epoch state
+        self.epoch_number = 0
+        self.last_minibatch = Bool(False)   # last of the TRAIN class
+        self.epoch_ended = Bool(False)
+        self.class_ended = Bool(False)      # last minibatch of any class
+        self.train_ended = Bool(False)
+        self._order: List[np.ndarray] = [np.empty(0, np.int64)] * 3
+        self._pos = 0
+        self._class_cursor = 0              # index into _present_classes
+        self._present_classes: List[int] = []
+
+    # -- subclass contract --------------------------------------------
+
+    def load_data(self) -> None:
+        raise NotImplementedError
+
+    def fill_minibatch(self) -> None:
+        """Populate minibatch_data/labels from minibatch_indices (host
+        path).  Subclasses may skip when the fused device path is on."""
+        raise NotImplementedError
+
+    # -- helpers -------------------------------------------------------
+
+    @property
+    def total_samples(self) -> int:
+        return int(sum(self.class_lengths))
+
+    @property
+    def max_minibatch_size(self) -> int:
+        return min(self.minibatch_size,
+                   max(c for c in self.class_lengths if c) if any(
+                       self.class_lengths) else self.minibatch_size)
+
+    def class_offset(self, klass: int) -> int:
+        """Global sample offset where ``klass`` starts (samples are laid
+        out test|valid|train like the reference)."""
+        return int(sum(self.class_lengths[:klass]))
+
+    # -- lifecycle -----------------------------------------------------
+
+    def initialize(self, device=None, **kwargs) -> None:
+        self.device = device
+        self.load_data()
+        if not any(self.class_lengths):
+            raise ValueError(f"{self.name}: load_data produced no samples")
+        self._present_classes = [c for c in (TEST, VALID, TRAIN)
+                                 if self.class_lengths[c] > 0]
+        self._reset_epoch()
+        # Allocate static-shaped minibatch vectors.
+        mb = self.max_minibatch_size
+        self.minibatch_indices.mem = np.zeros(mb, np.int32)
+        self.minibatch_mask.mem = np.zeros(mb, np.float32)
+        for v in (self.minibatch_indices, self.minibatch_mask):
+            v.initialize(device)
+        self.create_minibatch_data()
+
+    def create_minibatch_data(self) -> None:
+        """Subclasses allocate minibatch_data/labels here (host path)."""
+
+    def _reset_epoch(self) -> None:
+        self._class_cursor = 0
+        self._pos = 0
+        for c in (TEST, VALID, TRAIN):
+            n = self.class_lengths[c]
+            idx = np.arange(n, dtype=np.int64) + self.class_offset(c)
+            if c == TRAIN and self.shuffle_enabled:
+                prng.get(self.prng_stream).numpy.shuffle(idx)
+            self._order[c] = idx
+
+    # -- the firing ----------------------------------------------------
+
+    def run(self) -> None:
+        self.epoch_ended.set(False)
+        self.last_minibatch.set(False)
+        self.class_ended.set(False)
+        self.train_ended.set(False)
+
+        klass = self._present_classes[self._class_cursor]
+        order = self._order[klass]
+        n = len(order)
+        mb = self.max_minibatch_size
+        start = self._pos
+        stop = min(start + mb, n)
+        raw = order[start:stop]
+        size = len(raw)
+        # pad to static shape; padded rows masked out of metrics
+        idx = np.resize(raw, mb).astype(np.int32)
+        mask = np.zeros(mb, np.float32)
+        mask[:size] = 1.0
+
+        self.minibatch_class = klass
+        self.minibatch_offset = start
+        self.current_minibatch_size = size
+        self.minibatch_indices.map_invalidate()[:] = idx
+        self.minibatch_mask.map_invalidate()[:] = mask
+        self.fill_minibatch()
+
+        self._pos = stop
+        if stop >= n:  # class exhausted
+            self.class_ended.set(True)
+            if klass == TRAIN:
+                self.last_minibatch.set(True)
+                self.train_ended.set(True)
+            self._class_cursor += 1
+            self._pos = 0
+            if self._class_cursor >= len(self._present_classes):
+                self.epoch_ended.set(True)
+                self.epoch_number += 1
+                self._reset_epoch()
+
+    # -- distribution hooks (zmq DCN compat mode) ---------------------
+
+    def generate_data_for_slave(self, slave=None):
+        return {"indices": self.minibatch_indices.map_read().copy(),
+                "class": self.minibatch_class,
+                "size": self.current_minibatch_size}
+
+    def apply_data_from_master(self, data) -> None:
+        self.minibatch_class = data["class"]
+        self.current_minibatch_size = data["size"]
+        self.minibatch_indices.map_invalidate()[:] = data["indices"]
+        mask = np.zeros(self.max_minibatch_size, np.float32)
+        mask[:data["size"]] = 1.0
+        self.minibatch_mask.map_invalidate()[:] = mask
+        self.fill_minibatch()
+

@@ -1,0 +1,123 @@
+"""Full-batch loaders: the whole dataset memory-resident.
+
+Reference parity: veles/loader/fullbatch.py — ``FullBatchLoader`` keeps
+all samples in one array (optionally on device) and slices minibatches
+out of it; ``FullBatchLoaderMSE`` adds regression targets.
+
+TPU-first: ``original_data`` lives in HBM as one ``jax.Array``; the
+fused step receives minibatch *indices* and gathers rows on-device
+(``jnp.take``) — minibatch assembly never touches the host after
+initialization.  The host ``fill_minibatch`` path remains for the numpy
+backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from veles_tpu.loader.base import Loader, TEST, VALID, TRAIN
+from veles_tpu.memory import Vector
+
+
+class FullBatchLoader(Loader):
+    """Dataset fully resident; subclasses fill ``original_data`` /
+    ``original_labels`` in ``load_data``."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        #: all samples, laid out [test | valid | train] on axis 0
+        self.original_data = Vector(name="original_data")
+        #: integer class labels (classification) — may stay empty
+        self.original_labels = Vector(name="original_labels")
+        #: regression targets (MSE workflows) — may stay empty
+        self.original_targets = Vector(name="original_targets")
+        self.on_device = kwargs.get("on_device", True)
+
+    @property
+    def has_labels(self) -> bool:
+        return bool(self.original_labels)
+
+    @property
+    def has_targets(self) -> bool:
+        return bool(self.original_targets)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        for v in (self.original_data, self.original_labels,
+                  self.original_targets):
+            if v:
+                v.initialize(device if self.on_device else None)
+                if device is not None and device.is_jax and self.on_device:
+                    v.unmap()  # one-time HBM upload
+
+    def create_minibatch_data(self) -> None:
+        mb = self.max_minibatch_size
+        shape = (mb,) + tuple(self.original_data.shape[1:])
+        self.minibatch_data.mem = np.zeros(shape, self.original_data.dtype)
+        if self.has_labels:
+            self.minibatch_labels.mem = np.zeros(mb, np.int32)
+        if self.has_targets:
+            tshape = (mb,) + tuple(self.original_targets.shape[1:])
+            self.minibatch_targets = Vector(
+                np.zeros(tshape, self.original_targets.dtype),
+                name="minibatch_targets")
+        for v in (self.minibatch_data, self.minibatch_labels):
+            if v:
+                v.initialize(self.device)
+
+    def fill_minibatch(self) -> None:
+        idx = self.minibatch_indices.map_read()
+        data = self.original_data.mem
+        if data is None:
+            data = self.original_data.map_read()
+        self.minibatch_data.map_invalidate()[:] = data[idx]
+        if self.has_labels:
+            self.minibatch_labels.map_invalidate()[:] = \
+                self.original_labels.mem[idx]
+        if self.has_targets:
+            self.minibatch_targets.map_invalidate()[:] = \
+                self.original_targets.mem[idx]
+
+
+class ArrayLoader(FullBatchLoader):
+    """FullBatchLoader over in-memory numpy arrays per split.
+
+    ``train=(x, y)`` required; ``valid``/``test`` optional.  This is the
+    loader the synthetic datasets and most tests use.
+    """
+
+    def __init__(self, workflow=None,
+                 train: Optional[tuple] = None,
+                 valid: Optional[tuple] = None,
+                 test: Optional[tuple] = None,
+                 targets_from_labels: bool = False,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self._splits = {TRAIN: train, VALID: valid, TEST: test}
+        self._targets_from_labels = targets_from_labels
+
+    def load_data(self) -> None:
+        xs, ys, ts = [], [], []
+        for klass in (TEST, VALID, TRAIN):
+            split = self._splits[klass]
+            if split is None:
+                self.class_lengths[klass] = 0
+                continue
+            x = np.asarray(split[0])
+            self.class_lengths[klass] = len(x)
+            xs.append(x)
+            if len(split) > 1 and split[1] is not None:
+                ys.append(np.asarray(split[1]))
+            if len(split) > 2 and split[2] is not None:
+                ts.append(np.asarray(split[2]))
+        self.original_data.mem = np.concatenate(xs, axis=0)
+        if ys:
+            self.original_labels.mem = \
+                np.concatenate(ys, axis=0).astype(np.int32)
+        if ts:
+            self.original_targets.mem = np.concatenate(ts, axis=0)
+        elif self._targets_from_labels:
+            # autoencoder-style: target is the input itself
+            self.original_targets.mem = self.original_data.mem

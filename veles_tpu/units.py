@@ -129,6 +129,26 @@ class Unit(Logger):
 
     # -- scheduler internals (called by Workflow) ----------------------
 
+    # -- snapshot support (SURVEY.md §7 "whole-workflow pickling") -----
+
+    _unpicklable = ("device", "_compiled")
+
+    def __getstate__(self) -> dict:
+        """Drop device handles and compiled executables; everything else
+        (including the unit graph's cyclic refs) pickles.  Resume
+        re-attaches devices and re-jits (reference: snapshot contract,
+        SURVEY.md §4.4)."""
+        d = dict(self.__dict__)
+        for k in self._unpicklable:
+            d.pop(k, None)
+        return d
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        for k in self._unpicklable:
+            self.__dict__.setdefault(k, None)
+        self._initialized = False
+
     def fire(self) -> bool:
         """Execute one firing; returns True if ``run()`` actually ran."""
         if bool(self.gate_skip):
