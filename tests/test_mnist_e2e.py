@@ -1,0 +1,107 @@
+"""End-to-end MNIST All2All slice (SURVEY.md §7 phase 3, BASELINE
+config #1): loader -> All2AllTanh -> All2AllSoftmax -> evaluator -> GD
+chain -> decision loop, on both backends, numpy (eager graph) vs jax
+(fused single-step) agreement."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import JaxDevice, NumpyDevice
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+
+def build_workflow(max_epochs=3, mb=50, n_train=500, n_valid=200,
+                   momentum=0.0):
+    prng.seed_all(777)
+    train, valid, _ = synthetic_classification(
+        n_train, n_valid, (28, 28, 1), n_classes=10, seed=42)
+    loader_factory = lambda w: ArrayLoader(  # noqa: E731
+        w, train=train, valid=valid, minibatch_size=mb, name="loader")
+    gd = {"learning_rate": 0.1, "weight_decay": 0.0001,
+          "gradient_moment": momentum}
+    w = StandardWorkflow(
+        loader_factory=loader_factory,
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 64},
+             "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": max_epochs},
+        name="mnist_test")
+    return w
+
+
+def run_backend(device, **kwargs):
+    w = build_workflow(**kwargs)
+    w.initialize(device=device)
+    w.run()
+    return w
+
+
+class TestMnistEndToEnd:
+    def test_numpy_learns(self):
+        w = run_backend(NumpyDevice(), max_epochs=8)
+        # error must drop well below chance (90%)
+        assert w.decision.epoch_error_pct[1] < 30.0, \
+            w.decision.epoch_error_pct
+        assert w.decision.min_valid_epoch >= 0
+
+    def test_fused_jax_learns(self):
+        w = run_backend(JaxDevice(platform="cpu"), max_epochs=8)
+        assert w.decision.epoch_error_pct[1] < 30.0, \
+            w.decision.epoch_error_pct
+
+    def test_backends_agree(self):
+        """Same seed => identical init; trajectories must match
+        closely (fp reassociation differences only)."""
+        w_np = run_backend(NumpyDevice(), max_epochs=2)
+        w_jx = run_backend(JaxDevice(platform="cpu"), max_epochs=2)
+        hist_np = [h for h in w_np.decision.history
+                   if h["class"] == "validation"]
+        hist_jx = [h for h in w_jx.decision.history
+                   if h["class"] == "validation"]
+        assert len(hist_np) == len(hist_jx)
+        for a, b in zip(hist_np, hist_jx):
+            assert abs(a["loss"] - b["loss"]) < 5e-3, (a, b)
+            assert abs(a["n_err"] - b["n_err"]) <= 3, (a, b)
+
+    def test_momentum_backends_agree(self):
+        w_np = run_backend(NumpyDevice(), max_epochs=2, momentum=0.9)
+        w_jx = run_backend(JaxDevice(platform="cpu"), max_epochs=2,
+                           momentum=0.9)
+        a = w_np.decision.epoch_loss[1]
+        b = w_jx.decision.epoch_loss[1]
+        assert abs(a - b) < 1e-2, (a, b)
+
+    def test_eager_jax_matches_fused(self):
+        """Per-unit jax graph (fused=False) equals the fused step."""
+        dev = JaxDevice(platform="cpu")
+        w1 = build_workflow(max_epochs=1)
+        w1.initialize(device=dev, fused=False)
+        w1.run()
+        w2 = build_workflow(max_epochs=1)
+        w2.initialize(device=dev, fused=True)
+        w2.run()
+        a = w1.decision.epoch_loss[1]
+        b = w2.decision.epoch_loss[1]
+        assert abs(a - b) < 1e-4, (a, b)
+
+    def test_weights_update_and_readable(self):
+        w = run_backend(JaxDevice(platform="cpu"), max_epochs=1)
+        wts = w.forwards[0].weights.map_read()
+        assert np.isfinite(wts).all()
+        # initial weights came from the 'weights' stream; after one
+        # epoch they must have moved
+        prng.seed_all(777)
+        w2 = build_workflow()
+        w2.initialize(device=NumpyDevice())
+        assert not np.allclose(wts, w2.forwards[0].weights.mem)
+
+    def test_decision_history_structure(self):
+        w = run_backend(NumpyDevice(), max_epochs=2)
+        classes = [h["class"] for h in w.decision.history]
+        assert classes == ["validation", "train"] * 2

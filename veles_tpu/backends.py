@@ -81,7 +81,14 @@ class JaxDevice(Device):
         self._jit_cache: Dict[Any, Callable] = {}
 
     def put(self, array: np.ndarray) -> Any:
-        return self._jax.device_put(array, self.jax_device)
+        # Copy before upload: device_put may alias host memory (XLA:CPU
+        # is zero-copy) or defer the H2D transfer, and the map/unmap
+        # protocol lets callers mutate the host buffer right after
+        # unmap() while async-dispatched steps still read it.  The copy
+        # makes uploads value-snapshots, restoring the reference's
+        # enqueue-time semantics.
+        return self._jax.device_put(np.array(array, copy=True),
+                                    self.jax_device)
 
     def get(self, buf: Any) -> np.ndarray:
         return np.asarray(buf)

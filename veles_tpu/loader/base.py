@@ -61,6 +61,9 @@ class Loader(Unit, Distributable):
 
         # epoch state
         self.epoch_number = 0
+        #: the fused TPU path gathers rows on-device from the resident
+        #: dataset; host minibatch assembly is skipped entirely then
+        self.host_fill_enabled = True
         self.last_minibatch = Bool(False)   # last of the TRAIN class
         self.epoch_ended = Bool(False)
         self.class_ended = Bool(False)      # last minibatch of any class
@@ -154,7 +157,8 @@ class Loader(Unit, Distributable):
         self.current_minibatch_size = size
         self.minibatch_indices.map_invalidate()[:] = idx
         self.minibatch_mask.map_invalidate()[:] = mask
-        self.fill_minibatch()
+        if self.host_fill_enabled:
+            self.fill_minibatch()
 
         self._pos = stop
         if stop >= n:  # class exhausted

@@ -1,0 +1,13 @@
+"""znicz-equivalent neural-network op layer (reference: veles/znicz/).
+
+Each op family is a ForwardUnit plus a matching GradientUnit; compute is
+a pure ``apply`` function traceable by XLA (TPU path) with a hand-written
+numpy twin (golden path).  The production training path fuses every
+unit's apply into one jitted step — see veles_tpu/ops/fused.py.
+"""
+
+from veles_tpu.ops.nn_units import (  # noqa: F401
+    ForwardUnit, GradientUnit, NNWorkflow,
+)
+from veles_tpu.ops import all2all, evaluator, decision  # noqa: F401
+from veles_tpu.ops.registry import forward_registry, gd_for  # noqa: F401

@@ -1,0 +1,126 @@
+"""Fully-connected (All2All) units.
+
+Reference parity: veles/znicz/all2all.py — ``All2All`` (GEMM layer),
+``All2AllTanh``, ``All2AllRELU``, ``All2AllSoftmax``; and
+veles/znicz/gd.py — ``GradientDescent`` + per-activation variants.
+
+TPU-first: the GEMM is ``x @ W`` with W of shape (n_input, n_output) —
+a single MXU-friendly matmul; forward and backward are written against
+the shared numpy/jax array API, so ONE implementation serves the numpy
+golden path, per-unit jax execution, and the fused whole-step trace.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from veles_tpu.ops.nn_units import ForwardUnit, GradientUnit
+
+
+def _flat(x: Any) -> Any:
+    return x.reshape(x.shape[0], -1)
+
+
+class All2All(ForwardUnit):
+    """y = x @ W + b (linear)."""
+
+    activation_mode = "linear"
+
+    def __init__(self, workflow=None, output_sample_shape=None,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        if output_sample_shape is None:
+            raise ValueError(f"{self.name}: output_sample_shape required")
+        if isinstance(output_sample_shape, int):
+            output_sample_shape = (output_sample_shape,)
+        self.output_sample_shape = tuple(output_sample_shape)
+
+    @property
+    def neurons_number(self) -> int:
+        return int(np.prod(self.output_sample_shape))
+
+    def output_shape_for(self, input_shape: Tuple[int, ...]) \
+            -> Tuple[int, ...]:
+        return (input_shape[0],) + self.output_sample_shape
+
+    def param_shapes(self, input_shape: Tuple[int, ...]):
+        n_in = int(np.prod(input_shape[1:]))
+        shapes = {"weights": (n_in, self.neurons_number)}
+        if self.include_bias:
+            shapes["bias"] = (self.neurons_number,)
+        return shapes
+
+    # -- compute -------------------------------------------------------
+
+    def pre_activation(self, params, x):
+        v = _flat(x) @ params["weights"]
+        if "bias" in params:
+            v = v + params["bias"]
+        return v.reshape((x.shape[0],) + self.output_sample_shape)
+
+    def activation(self, v):
+        return v
+
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        return {"output": self.activation(
+            self.pre_activation(params, inputs["input"]))}
+
+
+class All2AllTanh(All2All):
+    activation_mode = "tanh"
+
+    def activation(self, v):
+        if isinstance(v, np.ndarray):
+            return np.tanh(v)
+        import jax.numpy as jnp
+        return jnp.tanh(v)
+
+
+class All2AllRELU(All2All):
+    activation_mode = "relu"
+
+    def activation(self, v):
+        if isinstance(v, np.ndarray):
+            return np.maximum(v, 0)
+        import jax.numpy as jnp
+        return jnp.maximum(v, 0)
+
+
+class All2AllSoftmax(All2All):
+    """Softmax output layer.  ``activation_mode == 'softmax'`` tells the
+    evaluator/GD contract that err_output already IS d loss/d logits
+    (the softmax+cross-entropy fusion; reference: EvaluatorSoftmax +
+    gd softmax variant)."""
+
+    activation_mode = "softmax"
+
+    def activation(self, v):
+        if isinstance(v, np.ndarray):
+            e = np.exp(v - v.max(axis=-1, keepdims=True))
+            return e / e.sum(axis=-1, keepdims=True)
+        import jax
+        return jax.nn.softmax(v, axis=-1)
+
+
+class GradientDescent(GradientUnit):
+    """Backward + update for any All2All variant.  One array-API
+    implementation serves numpy and jax (reference: veles/znicz/gd.py)."""
+
+    def backward_from_saved(self, params, saved, err_output):
+        x, out = saved
+        err_pre = self.act_deriv(out, err_output)
+        err_pre_flat = _flat(err_pre)
+        xf = _flat(x)
+        grads = {"weights": xf.T @ err_pre_flat}
+        if "bias" in params:
+            grads["bias"] = err_pre_flat.sum(axis=0)
+        err_input = (err_pre_flat @ params["weights"].T).reshape(x.shape)
+        return err_input, grads
+
+
+# per-activation aliases (reference: gd.GDTanh, gd.GDRELU, gd.GDSoftmax)
+GDTanh = GradientDescent
+GDRELU = GradientDescent
+GDSoftmax = GradientDescent

@@ -1,0 +1,148 @@
+"""Decision: end-of-epoch control logic.
+
+Reference parity: veles/znicz/decision.py — ``DecisionGD`` accumulates
+per-class epoch metrics from the evaluator, tracks the best validation
+error, raises ``improved`` (snapshot trigger), and decides when training
+is ``complete`` (max epochs reached, or no validation improvement for
+``fail_iterations`` epochs).  Its ``complete`` Bool gates the training
+loop's back edge.  Distributable in the reference (aggregates slave
+metrics); in SPMD mode metrics already arrive globally reduced.
+
+TPU-first: per-minibatch metric reads would force a device sync each
+step, so metric handles are accumulated lazily (device futures) and
+summed once per class end — JAX async dispatch keeps the device busy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from veles_tpu.distributable import Distributable
+from veles_tpu.loader.base import CLASS_NAMES, TEST, TRAIN, VALID
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+
+class DecisionGD(Unit, Distributable):
+    def __init__(self, workflow=None,
+                 max_epochs: Optional[int] = None,
+                 fail_iterations: int = 100,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.max_epochs = max_epochs
+        self.fail_iterations = fail_iterations
+        self.complete = Bool(False)
+        self.improved = Bool(False)
+        self.epoch_ended_flag = Bool(False)
+        # wired by link_attrs from the loader:
+        #   minibatch_class, class_ended, epoch_ended, epoch_number,
+        #   last_minibatch, class_lengths
+        # and from the evaluator: n_err Vector, loss Vector, count Vector
+        self.evaluator = None
+        self.loader = None
+        # epoch stats
+        self._acc_n_err: List[Any] = []
+        self._acc_loss: List[Any] = []
+        self._acc_count: List[Any] = []
+        self.epoch_n_err = [0.0, 0.0, 0.0]
+        self.epoch_loss = [0.0, 0.0, 0.0]
+        self.epoch_error_pct = [100.0, 100.0, 100.0]
+        self.min_valid_error = float("inf")
+        self.min_valid_epoch = -1
+        self.min_train_error = float("inf")
+        #: per-epoch history rows (epoch, class, n_err, loss, error%)
+        self.history: List[dict] = []
+
+    # -- metric intake -------------------------------------------------
+
+    def accumulate(self, n_err: Any, loss_sum: Any, count: Any) -> None:
+        """Called per minibatch (directly or via run()); values may be
+        device arrays — summed lazily at class end."""
+        self._acc_n_err.append(n_err)
+        self._acc_loss.append(loss_sum)
+        self._acc_count.append(count)
+
+    def _flush_class(self, klass: int) -> None:
+        n_err = float(np.sum([np.asarray(x).sum() for x in self._acc_n_err]))
+        loss = float(np.sum([np.asarray(x).sum() for x in self._acc_loss]))
+        count = float(np.sum([np.asarray(x).sum() for x in self._acc_count]))
+        self._acc_n_err.clear()
+        self._acc_loss.clear()
+        self._acc_count.clear()
+        self.epoch_n_err[klass] = n_err
+        self.epoch_loss[klass] = loss / max(count, 1.0)
+        self.epoch_error_pct[klass] = 100.0 * n_err / max(count, 1.0)
+        self.history.append({
+            "epoch": self.loader.epoch_number, "class": CLASS_NAMES[klass],
+            "n_err": n_err, "loss": self.epoch_loss[klass],
+            "error_pct": self.epoch_error_pct[klass], "count": count})
+
+    # -- firing --------------------------------------------------------
+
+    def run(self) -> None:
+        self.improved.set(False)
+        self.epoch_ended_flag.set(False)
+        ev = self.evaluator
+        if ev is not None:
+            nerr = ev.n_err.devmem if ev.n_err.devmem is not None \
+                else ev.n_err.mem
+            loss = ev.loss.devmem if ev.loss.devmem is not None \
+                else ev.loss.mem
+            count = ev.count.devmem if ev.count.devmem is not None \
+                else ev.count.mem
+            self.accumulate(nerr, loss, count)
+        ld = self.loader
+        if bool(ld.class_ended):
+            klass = ld.minibatch_class
+            self._flush_class(klass)
+            self.info("epoch %d %s: n_err=%g loss=%.6f error=%.2f%%",
+                      ld.epoch_number, CLASS_NAMES[klass],
+                      self.epoch_n_err[klass], self.epoch_loss[klass],
+                      self.epoch_error_pct[klass])
+            if klass == VALID:
+                self.on_validation_ended()
+            if klass == TRAIN:
+                self.on_train_ended()
+
+    def on_validation_ended(self) -> None:
+        err = self.epoch_n_err[VALID]
+        if err < self.min_valid_error:
+            self.min_valid_error = err
+            self.min_valid_epoch = self.loader.epoch_number
+            self.improved.set(True)
+
+    def on_train_ended(self) -> None:
+        self.epoch_ended_flag.set(True)
+        # Workflows without a validation split improve on train error.
+        if self.loader.class_lengths[VALID] == 0:
+            err = self.epoch_n_err[TRAIN]
+            if err < self.min_train_error:
+                self.min_train_error = err
+                self.improved.set(True)
+        epoch = self.loader.epoch_number  # already incremented past end
+        if self.max_epochs is not None and epoch >= self.max_epochs:
+            self.info("complete: reached max_epochs=%d", self.max_epochs)
+            self.complete.set(True)
+        if (self.loader.class_lengths[VALID] > 0
+                and self.min_valid_epoch >= 0
+                and epoch - self.min_valid_epoch > self.fail_iterations):
+            self.info("complete: no validation improvement in %d epochs",
+                      self.fail_iterations)
+            self.complete.set(True)
+
+    # -- distribution (zmq DCN compat mode) ---------------------------
+
+    def generate_data_for_master(self):
+        return {"n_err": [float(np.asarray(x).sum())
+                          for x in self._acc_n_err],
+                "loss": [float(np.asarray(x).sum())
+                         for x in self._acc_loss],
+                "count": [float(np.asarray(x).sum())
+                          for x in self._acc_count]}
+
+    def apply_data_from_slave(self, data, slave=None) -> None:
+        self._acc_n_err.extend(data["n_err"])
+        self._acc_loss.extend(data["loss"])
+        self._acc_count.extend(data["count"])

@@ -1,0 +1,234 @@
+"""The fused training step — the production TPU execution path.
+
+The reference executes one OpenCL/CUDA kernel per unit per minibatch
+(veles/accelerated_units.py execute_kernel).  Translating that 1:1
+would dispatch dozens of tiny XLA computations per step and lose badly
+(SURVEY.md §7 "hard parts").  Instead, the whole iteration —
+
+    gather minibatch rows from the HBM-resident dataset
+    -> every forward unit's apply
+    -> evaluator metrics + err_output
+    -> every gradient unit's backward + SGD update
+
+— is traced into ONE jitted function.  XLA fuses the elementwise chains
+into the matmuls/convs, keeps everything in HBM, and the parameter /
+optimizer pytrees are DONATED so updates are in-place in device memory.
+Separate train/eval traces give dropout-style units their two modes
+without traced branching.
+
+``FusedStepRunner`` is a drop-in graph node: it sits where the
+forwards+evaluator+gds chain would, reads the loader's minibatch
+indices, and rebinds every unit's Vectors (weights, output, metrics) to
+the step outputs — so Decision, Snapshotter, and plotters observe
+exactly what they would in eager mode, and ``map_read`` on any Vector
+still yields the current value.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.loader.base import TRAIN
+from veles_tpu import prng
+
+
+class FusedStepRunner(AcceleratedUnit):
+    def __init__(self, workflow=None, loader=None, forwards=None,
+                 evaluator=None, gds=None, rng_stream: str = "fused",
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.loader = loader
+        self.forwards: List[Any] = forwards or []
+        self.evaluator = evaluator
+        #: gds[i] is the GradientUnit of forwards[i] (may contain None
+        #: for frozen/param-less layers that still need err routing)
+        self.gds: List[Any] = gds or []
+        self.rng_stream = rng_stream
+        self._train_step = None
+        self._eval_step = None
+        self._params: Optional[Dict[str, Dict[str, Any]]] = None
+        self._opt: Optional[Dict[str, Dict[str, Any]]] = None
+        self._rng_counter = 0
+        self._conf_handles: List[Any] = []
+        self.lr_scale = 1.0  # lr_adjust policies write this
+
+    _unpicklable = AcceleratedUnit._unpicklable + (
+        "_train_step", "_eval_step", "_params", "_opt")
+
+    # -- pytree assembly ----------------------------------------------
+
+    def _collect_params(self) -> Dict[str, Dict[str, Any]]:
+        return {f.name: f.gather_params() for f in self.forwards}
+
+    def _collect_opt(self) -> Dict[str, Dict[str, Any]]:
+        opt = {}
+        for gd in self.gds:
+            if gd is None:
+                continue
+            opt[gd.name] = {k: v.unmap()
+                            for k, v in gd.accumulated_grads.items()}
+        return opt
+
+    def _scatter_params(self, params, opt) -> None:
+        """Rebind unit Vectors to the donated-step outputs so the rest
+        of the framework observes updated weights."""
+        for f in self.forwards:
+            p = params[f.name]
+            if "weights" in p:
+                f.weights.devmem = p["weights"]
+            if "bias" in p:
+                f.bias.devmem = p["bias"]
+        for gd in self.gds:
+            if gd is None:
+                continue
+            for k, vec in gd.accumulated_grads.items():
+                vec.devmem = opt[gd.name][k]
+
+    # -- trace construction -------------------------------------------
+
+    def _has_targets(self) -> bool:
+        return hasattr(self.evaluator, "target")
+
+    def _build_steps(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        forwards = list(self.forwards)
+        gds = list(self.gds)
+        evaluator = self.evaluator
+        n_fwd = len(forwards)
+        has_targets = self._has_targets()
+        want_confusion = bool(getattr(evaluator, "compute_confusion",
+                                      False))
+        n_classes = getattr(evaluator, "n_classes", None)
+        seed = prng.get(self.rng_stream).seed
+
+        def forward_pass(params, x, rng_counter, train: bool):
+            residuals = []
+            for i, f in enumerate(forwards):
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.key(seed), rng_counter), i) \
+                    if f.stochastic else None
+                x, res = f.apply_fwd(params[f.name], x, rng=rng, train=train)
+                residuals.append(res)
+            return x, residuals
+
+        def metrics_of(out, target, mask):
+            m = evaluator.metrics_fn(out, target, mask)
+            if want_confusion and n_classes is not None:
+                conf = jnp.zeros((n_classes, n_classes), jnp.int32)
+                conf = conf.at[target, m["max_idx"]].add(
+                    mask.astype(jnp.int32))
+                m["confusion"] = conf
+            return m
+
+        def gather(dataset, target_store, indices):
+            x = jnp.take(dataset, indices, axis=0)
+            t = jnp.take(target_store, indices, axis=0)
+            return x, t
+
+        def train_step(params, opt, dataset, target_store, indices, mask,
+                       lr_scale, rng_counter):
+            x, target = gather(dataset, target_store, indices)
+            out, residuals = forward_pass(params, x, rng_counter, True)
+            m = metrics_of(out, target, mask)
+            err = m.pop("err_output")
+            new_params = dict(params)
+            new_opt = dict(opt)
+            for i in range(n_fwd - 1, -1, -1):
+                f, gd = forwards[i], gds[i]
+                if gd is None:
+                    # param-less layer: still route the error back
+                    err = f.route_err(params[f.name], residuals[i], err) \
+                        if hasattr(f, "route_err") else err
+                    continue
+                err_in, grads = gd.backward_from_saved(
+                    params[f.name], residuals[i], err)
+                p, v = gd.update_params(params[f.name], grads,
+                                        opt.get(gd.name, {}), lr_scale)
+                new_params[f.name] = p
+                if gd.name in opt:
+                    new_opt[gd.name] = v
+                err = err_in
+            return new_params, new_opt, m
+
+        def eval_step(params, dataset, target_store, indices, mask,
+                      rng_counter):
+            x, target = gather(dataset, target_store, indices)
+            out, _ = forward_pass(params, x, rng_counter, False)
+            m = metrics_of(out, target, mask)
+            m.pop("err_output")
+            return m, out
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
+        self._eval_step = jax.jit(eval_step)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self._train_step is None:
+            self._build_steps()
+
+    def _target_store(self):
+        ld = self.loader
+        if self._has_targets():
+            return ld.original_targets.unmap()
+        return ld.original_labels.unmap()
+
+    def run(self) -> None:
+        ld = self.loader
+        ev = self.evaluator
+        if self._params is None:
+            self._params = self._collect_params()
+            self._opt = self._collect_opt()
+        indices = ld.minibatch_indices.unmap()
+        mask = ld.minibatch_mask.unmap()
+        dataset = ld.original_data.unmap()
+        targets = self._target_store()
+        if ld.minibatch_class == TRAIN:
+            self._params, self._opt, m = self._train_step(
+                self._params, self._opt, dataset, targets, indices, mask,
+                float(self.lr_scale), self._rng_counter)
+            self._scatter_params(self._params, self._opt)
+        else:
+            m, out = self._eval_step(self._params, dataset, targets,
+                                     indices, mask, self._rng_counter)
+            self.forwards[-1].output.devmem = out
+        self._rng_counter += 1
+        # Publish metrics through the evaluator's Vectors (device
+        # handles only — no sync; Decision sums lazily per class).
+        ev.n_err.devmem = m["n_err"]
+        ev.loss.devmem = m["loss_sum"]
+        ev.count.devmem = m["count"]
+        if "max_idx" in m:
+            ev.max_idx.devmem = m["max_idx"]
+        if "confusion" in m:
+            # keep device handles; fold into the host matrix once per
+            # class end (a sync per minibatch would stall the pipeline)
+            self._conf_handles.append(m["confusion"])
+            if bool(ld.class_ended) and ev.confusion:
+                for h in self._conf_handles:
+                    ev.confusion.mem += np.asarray(h)
+                self._conf_handles.clear()
+
+    # -- snapshot support ---------------------------------------------
+
+    def sync_params_to_vectors(self) -> None:
+        """Pull the current param pytree into host Vectors (snapshot)."""
+        if self._params is None:
+            return
+        self._scatter_params(self._params, self._opt or {})
+        for f in self.forwards:
+            for v in (f.weights, f.bias):
+                if v:
+                    v.map_read()
+
+    def __getstate__(self) -> dict:
+        self.sync_params_to_vectors()
+        d = super().__getstate__()
+        d["_conf_handles"] = []
+        return d

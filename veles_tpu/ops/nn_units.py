@@ -1,0 +1,322 @@
+"""Bases for neural-network units.
+
+Reference parity: veles/znicz/nn_units.py — ``ForwardBase`` (input,
+output, weights, bias Vectors; weight filling from config) and
+``GradientDescentBase`` (err_output -> err_input routing, learning
+rate / weight decay / momentum, in-place weight update), plus
+``NNWorkflow``.
+
+TPU-first contract:
+
+- ``ForwardUnit.apply(params, inputs, rng)`` is PURE and traceable; the
+  same Python code usually serves numpy (golden) and jax (TPU) because
+  the two share the array API; ops that need backend-specific code
+  (conv, pooling) dispatch on array type via ``is_host_array``.
+- ``GradientUnit.backward(params, inputs, err_output)`` returns
+  ``(err_input, param_grads)``.  The default jax path derives it with
+  ``jax.vjp`` of the forward's apply (activation derivative handled by
+  the ``activation_mode`` contract for softmax+CE fusion); the numpy
+  path is explicit hand-written math — an independent oracle the tests
+  compare against.
+- Weight update is xp-agnostic: ``w -= lr * (grad + weight_decay * w)``
+  with optional momentum buffers, matching the reference's SGD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.memory import Vector
+from veles_tpu.workflow import Workflow
+
+
+def is_host_array(x: Any) -> bool:
+    """True when ``x`` is a plain numpy array (golden path); False for
+    jax arrays and tracers."""
+    return isinstance(x, np.ndarray)
+
+
+class ForwardUnit(AcceleratedUnit):
+    """Base forward unit: input -> output, optional weights/bias."""
+
+    #: how a GradientUnit must treat this unit's nonlinearity:
+    #: "linear" | "tanh" | "relu" | "sigmoid" | "softmax" (softmax's
+    #: derivative is fused into the evaluator's err_output contract).
+    activation_mode = "linear"
+    has_params = True
+    _unpicklable = AcceleratedUnit._unpicklable + ("_last_residual",)
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        #: input is usually an alias to the producer's Vector — set via
+        #: link_attrs(prev, ("input", "output")) or direct assignment.
+        self.input = Vector(name=f"{self.name}.input")
+        self.output = Vector(name=f"{self.name}.output")
+        self.weights = Vector(name=f"{self.name}.weights")
+        self.bias = Vector(name=f"{self.name}.bias")
+        self.include_bias = kwargs.get("include_bias", True)
+        self.weights_filling = kwargs.get("weights_filling", "uniform")
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+        self.bias_filling = kwargs.get("bias_filling", "constant")
+        self.bias_stddev = kwargs.get("bias_stddev", 0.0)
+        self.declare_output("output", self.output)
+
+    # -- shapes & params ----------------------------------------------
+
+    def output_shape_for(self, input_shape: Tuple[int, ...]) \
+            -> Tuple[int, ...]:
+        raise NotImplementedError
+
+    def param_shapes(self, input_shape: Tuple[int, ...]) \
+            -> Dict[str, Tuple[int, ...]]:
+        """{} when the unit has no parameters."""
+        return {}
+
+    def fill_params(self, input_shape: Tuple[int, ...]) -> None:
+        """Deterministic init through the 'weights' PRNG stream — both
+        backends see identical initial parameters."""
+        shapes = self.param_shapes(input_shape)
+        if not shapes:
+            return
+        gen = prng.get("weights").numpy
+        for pname, shape in shapes.items():
+            filling = self.weights_filling if pname == "weights" \
+                else self.bias_filling
+            stddev = self.weights_stddev if pname == "weights" \
+                else self.bias_stddev
+            if stddev is None:
+                fan_in = int(np.prod(shape[:-1])) or 1
+                stddev = 1.0 / np.sqrt(fan_in)
+            if filling == "uniform":
+                arr = gen.uniform(-stddev * np.sqrt(3), stddev * np.sqrt(3),
+                                  shape)
+            elif filling == "gaussian":
+                arr = gen.normal(0.0, stddev, shape)
+            elif filling == "constant":
+                arr = np.full(shape, stddev)
+            else:
+                raise ValueError(f"unknown filling {filling!r}")
+            getattr(self, pname).mem = arr.astype(np.float32)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        in_shape = tuple(self.input.shape)
+        if not self.weights and self.param_shapes(in_shape):
+            self.fill_params(in_shape)
+        out_shape = self.output_shape_for(in_shape)
+        if not self.output or tuple(self.output.shape) != out_shape:
+            self.output.mem = np.zeros(out_shape, np.float32)
+        for v in (self.input, self.weights, self.bias, self.output):
+            if v:
+                v.initialize(device)
+
+    def gather_inputs(self) -> Dict[str, Any]:
+        return {"input": self.input.unmap()}
+
+    # -- pure compute --------------------------------------------------
+
+    def gather_params(self) -> Dict[str, Any]:
+        p = {}
+        if self.weights:
+            p["weights"] = self.weights.unmap()
+        if self.bias and self.include_bias:
+            p["bias"] = self.bias.unmap()
+        return p
+
+    def apply(self, params: Dict[str, Any], inputs: Dict[str, Any],
+              rng: Any = None) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    #: True when apply() consumes a PRNG key in training mode (dropout).
+    stochastic = False
+
+    def apply_fwd(self, params: Dict[str, Any], x: Any, rng: Any = None,
+                  train: bool = True) -> Tuple[Any, Any]:
+        """(output, residual) — the fused-step forward contract.
+        ``residual`` is whatever the matching GradientUnit's
+        ``backward_from_saved`` needs; default (input, output)."""
+        y = self.apply(params, {"input": x}, rng)["output"]
+        return y, (x, y)
+
+    @property
+    def in_training(self) -> bool:
+        """True while the current minibatch is a TRAIN one (dropout &co
+        switch behaviour); resolved through the owning workflow's
+        loader when present."""
+        ld = getattr(self.workflow, "loader", None)
+        if ld is None:
+            return True
+        from veles_tpu.loader.base import TRAIN
+        return ld.minibatch_class == TRAIN
+
+    # -- eager firing --------------------------------------------------
+
+    def numpy_run(self) -> None:
+        params = {k: np.asarray(v) for k, v in self.gather_params().items()}
+        x = self.input.map_read()
+        y, res = self.apply_fwd(params, x, rng=self.eager_rng(),
+                                train=self.in_training)
+        self._last_residual = res
+        self.output.map_invalidate()[:] = np.asarray(y)
+
+    def jax_run(self) -> None:
+        params = self.gather_params()
+        x = self.input.unmap()
+        y, res = self.apply_fwd(params, x, rng=self.eager_rng(),
+                                train=self.in_training)
+        self._last_residual = res
+        self.output.devmem = y
+
+    def eager_rng(self) -> Any:
+        """Per-firing randomness for eager modes; stochastic subclasses
+        override (fused mode threads keys explicitly)."""
+        return None
+
+
+class GradientUnit(AcceleratedUnit):
+    """Backward + SGD update for one ForwardUnit.
+
+    Reference parity: veles/znicz/gd*.py — consumes ``err_output``
+    (dL/d output), produces ``err_input`` (dL/d input), computes
+    weight/bias gradients and applies the update in place.
+    """
+
+    def __init__(self, workflow=None, forward: Optional[ForwardUnit] = None,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.forward = forward
+        self.err_output = Vector(name=f"{self.name}.err_output")
+        self.err_input = Vector(name=f"{self.name}.err_input")
+        self.learning_rate = kwargs.get("learning_rate", 0.01)
+        self.learning_rate_bias = kwargs.get("learning_rate_bias",
+                                             kwargs.get("learning_rate", 0.01))
+        self.weight_decay = kwargs.get("weight_decay", 0.0)
+        self.weight_decay_bias = kwargs.get("weight_decay_bias", 0.0)
+        self.gradient_moment = kwargs.get("gradient_moment", 0.0)
+        #: momentum buffers, allocated lazily
+        self.accumulated_grads: Dict[str, Vector] = {}
+        self.declare_input("err_output", self.err_output)
+        self.declare_output("err_input", self.err_input)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        f = self.forward
+        if f is not None and not self.err_input:
+            # raises AttributeError until the forward is initialized ->
+            # Workflow.initialize retries us later
+            self.err_input.mem = np.zeros(f.input.shape, np.float32)
+            self.err_input.initialize(device)
+        if self.gradient_moment and f is not None:
+            for pname, vec in (("weights", f.weights), ("bias", f.bias)):
+                if vec and pname not in self.accumulated_grads:
+                    acc = Vector(np.zeros(vec.shape, np.float32),
+                                 name=f"{self.name}.vel_{pname}")
+                    acc.initialize(device)
+                    self.accumulated_grads[pname] = acc
+
+    # -- backward ------------------------------------------------------
+
+    def act_deriv(self, output, err_output):
+        """dL/d(pre-activation) from dL/d(output), using the forward's
+        activation_mode contract (softmax: the evaluator already folded
+        the jacobian into err_output — the softmax+CE fusion)."""
+        mode = self.forward.activation_mode
+        if mode in ("linear", "softmax"):
+            return err_output
+        if mode == "tanh":
+            return err_output * (1.0 - output * output)
+        if mode == "relu":
+            return err_output * (output > 0).astype(output.dtype)
+        if mode == "sigmoid":
+            return err_output * output * (1.0 - output)
+        raise ValueError(f"unknown activation_mode {mode!r}")
+
+    def backward_from_saved(self, params: Dict[str, Any],
+                            saved: Tuple[Any, Any], err_output: Any) \
+            -> Tuple[Any, Dict[str, Any]]:
+        """(err_input, param_grads) from residuals ``saved = (input,
+        output)`` of the forward pass.  Written against the shared
+        numpy/jax array API so one implementation serves the numpy
+        golden path, eager jax, and the fused whole-step trace."""
+        raise NotImplementedError
+
+    # -- update --------------------------------------------------------
+
+    def update_params(self, params: Dict[str, Any],
+                      grads: Dict[str, Any],
+                      velocities: Dict[str, Any],
+                      lr_scale: Any = 1.0) -> Tuple[Dict[str, Any],
+                                                    Dict[str, Any]]:
+        """Pure xp-agnostic SGD(+momentum) update; returns (new_params,
+        new_velocities)."""
+        new_p, new_v = {}, {}
+        for pname, w in params.items():
+            g = grads[pname]
+            lr = (self.learning_rate if pname == "weights"
+                  else self.learning_rate_bias) * lr_scale
+            wd = self.weight_decay if pname == "weights" \
+                else self.weight_decay_bias
+            g = g + wd * w
+            if self.gradient_moment:
+                v = velocities[pname]
+                v = self.gradient_moment * v - lr * g
+                new_v[pname] = v
+                new_p[pname] = w + v
+            else:
+                new_p[pname] = w - lr * g
+        return new_p, new_v
+
+    # -- eager firing (numpy / per-unit jax graph mode) ---------------
+
+    def run(self) -> None:
+        f = self.forward
+        numpy_mode = isinstance(self.device, NumpyDevice) or \
+            self.device is None
+        saved = getattr(f, "_last_residual", None)
+        if numpy_mode:
+            params = {k: np.asarray(v) for k, v in f.gather_params().items()}
+            if saved is None:
+                saved = (f.input.map_read(), f.output.map_read())
+            err_out = self.err_output.map_read()
+            vel = {k: v.map_read() for k, v in self.accumulated_grads.items()}
+        else:
+            params = f.gather_params()
+            if saved is None:
+                saved = (f.input.unmap(), f.output.unmap())
+            err_out = self.err_output.unmap()
+            vel = {k: v.unmap() for k, v in self.accumulated_grads.items()}
+        err_in, grads = self.backward_from_saved(params, saved, err_out)
+        new_p, new_v = self.update_params(params, grads, vel)
+        if numpy_mode:
+            for pname, arr in new_p.items():
+                getattr(f, pname).map_invalidate()[:] = arr
+            for pname, arr in new_v.items():
+                self.accumulated_grads[pname].map_invalidate()[:] = arr
+            if self.err_input:
+                self.err_input.map_invalidate()[:] = err_in
+        else:
+            for pname, arr in new_p.items():
+                getattr(f, pname).devmem = arr
+            for pname, arr in new_v.items():
+                self.accumulated_grads[pname].devmem = arr
+            if self.err_input:
+                self.err_input.devmem = err_in
+
+
+class NNWorkflow(Workflow):
+    """Workflow with the conventional NN roles bound by name
+    (reference: veles/znicz/nn_units.py NNWorkflow)."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.loader = None
+        self.forwards: list = []
+        self.gds: list = []
+        self.evaluator = None
+        self.decision = None
+        self.snapshotter = None

@@ -1,0 +1,73 @@
+"""Unit type registry: layer-config name -> (ForwardUnit, GradientUnit).
+
+Reference parity: veles/znicz/standard_workflow.py resolves the
+``layers = [{"type": ...}]`` declarative config through a name->class
+mapping; this is that mapping.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple, Type
+
+forward_registry: Dict[str, Tuple[type, type]] = {}
+
+
+def register(name: str, forward_cls: type, gd_cls: type) -> None:
+    forward_registry[name] = (forward_cls, gd_cls)
+
+
+def gd_for(name: str) -> type:
+    return forward_registry[name][1]
+
+
+def _populate() -> None:
+    from veles_tpu.ops import all2all
+    register("all2all", all2all.All2All, all2all.GradientDescent)
+    register("all2all_tanh", all2all.All2AllTanh, all2all.GDTanh)
+    register("all2all_relu", all2all.All2AllRELU, all2all.GDRELU)
+    register("softmax", all2all.All2AllSoftmax, all2all.GDSoftmax)
+    try:
+        from veles_tpu.ops import conv as conv_mod
+        register("conv", conv_mod.Conv, conv_mod.GradientDescentConv)
+        register("conv_tanh", conv_mod.ConvTanh, conv_mod.GradientDescentConv)
+        register("conv_relu", conv_mod.ConvRELU, conv_mod.GradientDescentConv)
+    except ImportError:
+        pass
+    try:
+        from veles_tpu.ops import pooling
+        register("max_pooling", pooling.MaxPooling, pooling.GDMaxPooling)
+        register("avg_pooling", pooling.AvgPooling, pooling.GDAvgPooling)
+        register("stochastic_pooling", pooling.StochasticPooling,
+                 pooling.GDMaxPooling)
+    except ImportError:
+        pass
+    try:
+        from veles_tpu.ops import activation as act
+        register("activation_tanh", act.ActivationTanh, act.GDActivation)
+        register("activation_relu", act.ActivationRELU, act.GDActivation)
+        register("activation_sigmoid", act.ActivationSigmoid,
+                 act.GDActivation)
+        register("activation_log", act.ActivationLog, act.GDActivation)
+        register("activation_strict_relu", act.ActivationStrictRELU,
+                 act.GDActivation)
+    except ImportError:
+        pass
+    try:
+        from veles_tpu.ops import dropout
+        register("dropout", dropout.Dropout, dropout.GDDropout)
+    except ImportError:
+        pass
+    try:
+        from veles_tpu.ops import lrn
+        register("norm", lrn.LRNormalizer, lrn.GDLRNormalizer)
+    except ImportError:
+        pass
+    try:
+        from veles_tpu.ops import deconv, depooling
+        register("deconv", deconv.Deconv, deconv.GradientDescentDeconv)
+        register("depooling", depooling.Depooling, depooling.GDDepooling)
+    except ImportError:
+        pass
+
+
+_populate()

@@ -1,0 +1,196 @@
+"""StandardWorkflow: build a full training workflow from a declarative
+``layers`` config.
+
+Reference parity: veles/znicz/standard_workflow.py — the API all five
+BASELINE.json configs go through: a list of layer dicts
+``{"type": "conv", "->": {forward params}, "<-": {gd params}}`` becomes
+loader -> forwards -> evaluator -> gd chain (reversed) -> decision ->
+loop, plus snapshotter and plotters (SURVEY.md §4.5).
+
+TPU-first: on a jax device the forwards/evaluator/gds are NOT linked
+into the control graph — a single FusedStepRunner node executes the
+whole iteration as one jitted call (ops/fused.py).  On the numpy
+backend the classic unit-by-unit graph runs, serving as the golden
+path.  The same StandardWorkflow instance can be re-wired for either
+mode at initialize() time (snapshot on TPU, resume on numpy, etc.).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from veles_tpu.backends import Device
+from veles_tpu.loader.base import TRAIN, Loader
+from veles_tpu.mutable import Bool
+from veles_tpu.ops.decision import DecisionGD
+from veles_tpu.ops.evaluator import EvaluatorMSE, EvaluatorSoftmax
+from veles_tpu.ops.fused import FusedStepRunner
+from veles_tpu.ops.nn_units import NNWorkflow
+from veles_tpu.ops.registry import forward_registry
+from veles_tpu.workflow import Repeater
+
+
+class StandardWorkflow(NNWorkflow):
+    def __init__(self, workflow=None,
+                 loader: Optional[Loader] = None,
+                 loader_factory: Optional[Callable[..., Loader]] = None,
+                 layers: Optional[List[Dict[str, Any]]] = None,
+                 loss_function: str = "softmax",
+                 decision_config: Optional[Dict[str, Any]] = None,
+                 snapshotter_config: Optional[Dict[str, Any]] = None,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.loss_function = loss_function
+        self.layers_config = layers or []
+
+        self.repeater = Repeater(self, name="repeater")
+        if loader is None:
+            if loader_factory is None:
+                raise ValueError("need loader or loader_factory")
+            loader = loader_factory(self)
+        elif loader.workflow is not self:
+            self.add_unit(loader)
+        self.loader = loader
+
+        self._create_forwards()
+        self._create_evaluator()
+        self._create_gds()
+        self._create_decision(decision_config or {})
+        self._create_snapshotter(snapshotter_config)
+        self.fused = FusedStepRunner(
+            self, loader=self.loader, forwards=self.forwards,
+            evaluator=self.evaluator, gds=self.gds, name="fused_step")
+        self._extra_after_decision: list = []
+
+    # -- unit creation -------------------------------------------------
+
+    def _create_forwards(self) -> None:
+        self.forwards = []
+        prev = None
+        for i, cfg in enumerate(self.layers_config):
+            kind = cfg["type"]
+            if kind not in forward_registry:
+                raise ValueError(f"unknown layer type {kind!r}; have "
+                                 f"{sorted(forward_registry)}")
+            fwd_cls, _ = forward_registry[kind]
+            fwd_kwargs = dict(cfg.get("->", {}))
+            unit = fwd_cls(self, name=f"fwd{i}_{kind}", **fwd_kwargs)
+            if prev is None:
+                unit.link_attrs(self.loader, ("input", "minibatch_data"))
+            else:
+                unit.link_attrs(prev, ("input", "output"))
+            self.forwards.append(unit)
+            prev = unit
+
+    def _create_evaluator(self) -> None:
+        last = self.forwards[-1]
+        if self.loss_function == "softmax":
+            ev = EvaluatorSoftmax(self, name="evaluator")
+            ev.link_attrs(last, ("input", "output"))
+            ev.link_attrs(self.loader, ("labels", "minibatch_labels"),
+                          ("mask", "minibatch_mask"))
+        elif self.loss_function == "mse":
+            ev = EvaluatorMSE(self, name="evaluator")
+            ev.link_attrs(last, ("input", "output"))
+            ev.link_attrs(self.loader, ("target", "minibatch_targets"),
+                          ("mask", "minibatch_mask"))
+        else:
+            raise ValueError(f"unknown loss {self.loss_function!r}")
+        self.evaluator = ev
+
+    def _create_gds(self) -> None:
+        self.gds = []
+        loader = self.loader
+        for i, (cfg, fwd) in enumerate(zip(self.layers_config,
+                                           self.forwards)):
+            kind = cfg["type"]
+            _, gd_cls = forward_registry[kind]
+            gd_kwargs = dict(cfg.get("<-", {}))
+            gd = gd_cls(self, forward=fwd, name=f"gd{i}_{kind}",
+                        **gd_kwargs)
+            # never train on validation/test minibatches
+            gd.gate_skip = Bool.from_expr(
+                lambda ld=loader: ld.minibatch_class != TRAIN)
+            self.gds.append(gd)
+
+    def _create_decision(self, cfg: Dict[str, Any]) -> None:
+        self.decision = DecisionGD(self, name="decision", **cfg)
+        self.decision.loader = self.loader
+        self.decision.evaluator = self.evaluator
+
+    def _create_snapshotter(self, cfg: Optional[Dict[str, Any]]) -> None:
+        self.snapshotter = None
+        if cfg is None:
+            return
+        from veles_tpu.snapshotter import Snapshotter
+        self.snapshotter = Snapshotter(self, name="snapshotter", **cfg)
+        self.snapshotter.decision = self.decision
+
+    # -- wiring --------------------------------------------------------
+
+    def _clear_control_links(self) -> None:
+        for u in self.units:
+            u.links_from.clear()
+            u.links_to.clear()
+
+    def _wire_common_tail(self, before_decision) -> None:
+        self.decision.link_from(before_decision)
+        tail = self.decision
+        if self.snapshotter is not None:
+            self.snapshotter.link_from(self.decision)
+            self.snapshotter.gate_skip = Bool.from_expr(
+                lambda d=self.decision: not (bool(d.epoch_ended_flag)
+                                             and bool(d.improved)))
+            tail = self.snapshotter
+        for extra in self._extra_after_decision:
+            extra.link_from(tail)
+            tail = extra
+        self.repeater.link_from(tail)          # loop back edge
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.link_from(tail)
+        self.end_point.gate_block = ~self.decision.complete
+
+    def wire_eager(self) -> None:
+        """Classic per-unit graph (numpy golden path)."""
+        self._clear_control_links()
+        self.loader.host_fill_enabled = True
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        prev = self.loader
+        for f in self.forwards:
+            f.link_from(prev)
+            prev = f
+        self.evaluator.link_from(prev)
+        # backward chain, reversed; err chains via link_attrs
+        prev = self.evaluator
+        last_gd = None
+        for i in range(len(self.gds) - 1, -1, -1):
+            gd = self.gds[i]
+            if last_gd is None:
+                gd.link_attrs(self.evaluator, "err_output")
+            else:
+                gd.link_attrs(last_gd, ("err_output", "err_input"))
+            gd.link_from(prev)
+            prev = gd
+            last_gd = gd
+        self._wire_common_tail(prev)
+
+    def wire_fused(self) -> None:
+        """Single fused jitted step per iteration (TPU path)."""
+        self._clear_control_links()
+        self.loader.host_fill_enabled = False
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.fused.link_from(self.loader)
+        self._wire_common_tail(self.fused)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def initialize(self, device: Optional[Device] = None, **kwargs) -> None:
+        use_fused = device is not None and device.is_jax \
+            and kwargs.pop("fused", True)
+        if use_fused:
+            self.wire_fused()
+        else:
+            self.wire_eager()
+        super().initialize(device=device, **kwargs)
