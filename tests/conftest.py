@@ -7,11 +7,22 @@ Must run before the first `import jax` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the driver environment presets JAX_PLATFORMS=axon (the
+# real TPU chip) and its sitecustomize sets jax_platforms
+# programmatically, so the env var alone is not enough — update the
+# jax config before any backend initializes.  Unit tests must be fast,
+# f32-exact, and see 8 virtual devices for sharding coverage.  TPU
+# smoke tests opt back in explicitly.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
 
 import pytest  # noqa: E402
 

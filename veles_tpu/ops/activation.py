@@ -1,0 +1,96 @@
+"""Standalone activation units (forward + backward pairs).
+
+Reference parity: veles/znicz/activation.py — separate activation
+units usable between any two layers: tanh, sigmoid, log (asinh-style),
+strict relu (max(0,x)), relu (softplus ln(1+e^x) — the reference's
+historic "RELU").  Param-less; one xp-agnostic implementation serves
+both backends.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from veles_tpu.ops.nn_units import ForwardUnit, GradientUnit
+
+
+def _xp(x):
+    if isinstance(x, np.ndarray):
+        return np
+    import jax.numpy as jnp
+    return jnp
+
+
+class ActivationBase(ForwardUnit):
+    has_params = False
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def param_shapes(self, input_shape):
+        return {}
+
+    def fwd(self, xp, x):
+        raise NotImplementedError
+
+    def bwd(self, xp, x, y, err_output):
+        """dL/dx from (x, y, dL/dy)."""
+        raise NotImplementedError
+
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        x = inputs["input"]
+        return {"output": self.fwd(_xp(x), x)}
+
+
+class ActivationTanh(ActivationBase):
+    def fwd(self, xp, x):
+        return xp.tanh(x)
+
+    def bwd(self, xp, x, y, err_output):
+        return err_output * (1.0 - y * y)
+
+
+class ActivationSigmoid(ActivationBase):
+    def fwd(self, xp, x):
+        return 1.0 / (1.0 + xp.exp(-x))
+
+    def bwd(self, xp, x, y, err_output):
+        return err_output * y * (1.0 - y)
+
+
+class ActivationStrictRELU(ActivationBase):
+    """max(0, x) (reference: StrictRELU)."""
+
+    def fwd(self, xp, x):
+        return xp.maximum(x, 0)
+
+    def bwd(self, xp, x, y, err_output):
+        return err_output * (y > 0).astype(err_output.dtype)
+
+
+class ActivationRELU(ActivationBase):
+    """ln(1 + e^x) — softplus, the reference's historic 'RELU'."""
+
+    def fwd(self, xp, x):
+        return xp.log1p(xp.exp(-xp.abs(x))) + xp.maximum(x, 0)
+
+    def bwd(self, xp, x, y, err_output):
+        return err_output / (1.0 + xp.exp(-x))
+
+
+class ActivationLog(ActivationBase):
+    """ln(x + sqrt(x^2 + 1)) = asinh(x) (reference: activation.log)."""
+
+    def fwd(self, xp, x):
+        return xp.arcsinh(x)
+
+    def bwd(self, xp, x, y, err_output):
+        return err_output / xp.sqrt(x * x + 1.0)
+
+
+class GDActivation(GradientUnit):
+    def backward_from_saved(self, params, saved, err_output):
+        x, y = saved
+        return self.forward.bwd(_xp(err_output), x, y, err_output), {}

@@ -1,0 +1,112 @@
+"""Deconvolution (transposed convolution) for autoencoders.
+
+Reference parity: veles/znicz/deconv.py + gd_deconv.py — the decoder
+halves of MnistAE.  A Deconv with kernel/stride/padding S inverts the
+geometry of a Conv with the same S: output H = (OH-1)*stride + k - 2*pad.
+
+TPU path: ``lax.conv_transpose``; numpy golden path reuses col2im (a
+transposed conv IS col2im of per-position weighted patches).  Backward
+of a transposed conv is a plain conv — derived via jax.vjp on the TPU
+path, explicit im2col on the numpy path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from veles_tpu.ops.conv import _pair, im2col, col2im
+from veles_tpu.ops.nn_units import ForwardUnit, GradientUnit
+
+
+class Deconv(ForwardUnit):
+    """Transposed 2-D convolution, NHWC; weights HWOI-style
+    (ky, kx, n_output_channels, n_input_channels) so a Conv's weight
+    shape transposes naturally."""
+
+    activation_mode = "linear"
+
+    def __init__(self, workflow=None, n_kernels: int = None,  # type: ignore
+                 kx: int = 3, ky: int = 3, padding: Any = 0,
+                 sliding: Any = 1, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        if n_kernels is None:
+            raise ValueError(f"{self.name}: n_kernels required "
+                             "(number of OUTPUT channels)")
+        self.n_kernels = n_kernels
+        self.kx, self.ky = kx, ky
+        self.padding = _pair(padding)
+        self.sliding = _pair(sliding)
+
+    def output_shape_for(self, input_shape):
+        b, h, w, _c = input_shape
+        py, px = self.padding
+        sy, sx = self.sliding
+        return (b, (h - 1) * sy + self.ky - 2 * py,
+                (w - 1) * sx + self.kx - 2 * px, self.n_kernels)
+
+    def param_shapes(self, input_shape):
+        c_in = input_shape[-1]
+        shapes = {"weights": (self.ky, self.kx, self.n_kernels, c_in)}
+        if self.include_bias:
+            shapes["bias"] = (self.n_kernels,)
+        return shapes
+
+    def pre_activation(self, params, x):
+        if isinstance(x, np.ndarray):
+            b, h, w, c_in = x.shape
+            wmat = params["weights"].reshape(
+                self.ky * self.kx * self.n_kernels, c_in)
+            cols = (x.reshape(-1, c_in) @ wmat.T).reshape(
+                b, h, w, self.ky, self.kx, self.n_kernels)
+            out_shape = self.output_shape_for(x.shape)
+            v = col2im(cols, out_shape, self.padding, self.sliding)
+        else:
+            import jax.numpy as jnp
+            from jax import lax
+            py, px = self.padding
+            sy, sx = self.sliding
+            # transposed conv == input-dilated conv with the spatially
+            # flipped, io-transposed kernel (textbook adjoint form)
+            k2 = jnp.flip(params["weights"], (0, 1)).transpose(0, 1, 3, 2)
+            v = lax.conv_general_dilated(
+                x, k2, window_strides=(1, 1),
+                padding=((self.ky - 1 - py, self.ky - 1 - py),
+                         (self.kx - 1 - px, self.kx - 1 - px)),
+                lhs_dilation=(sy, sx),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if "bias" in params:
+            v = v + params["bias"]
+        return v
+
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        return {"output": self.pre_activation(params, inputs["input"])}
+
+
+class GradientDescentDeconv(GradientUnit):
+    def backward_from_saved(self, params, saved, err_output):
+        f = self.forward
+        x, out = saved
+        err_pre = self.act_deriv(out, err_output)
+        if isinstance(err_output, np.ndarray):
+            # backward of col2im is im2col: gather patches of err_pre
+            patches = im2col(err_pre, f.ky, f.kx, f.padding, f.sliding)
+            b, oh, ow = patches.shape[:3]  # == x's spatial dims
+            pf = patches.reshape(b * oh * ow, -1)   # (N, ky*kx*K)
+            xf = x.reshape(b * oh * ow, -1)         # (N, C_in)
+            grads = {"weights": (pf.T @ xf).reshape(
+                f.ky, f.kx, f.n_kernels, x.shape[-1])}
+            if "bias" in params:
+                grads["bias"] = err_pre.sum(axis=(0, 1, 2))
+            err_input = (pf @ params["weights"]
+                         .reshape(-1, x.shape[-1])).reshape(x.shape)
+            return err_input, grads
+        import jax
+
+        def pre(p, xx):
+            return f.pre_activation(p, xx)
+
+        _, vjp = jax.vjp(pre, params, x)
+        grads, err_input = vjp(err_pre)
+        return err_input, grads

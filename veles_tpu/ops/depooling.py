@@ -1,0 +1,55 @@
+"""Depooling: the decoder-side inverse of pooling.
+
+Reference parity: veles/znicz/depooling.py — upsamples by spreading
+each input value uniformly over its (ky, kx) window (the adjoint of
+AvgPooling scaled by the window size), used by MnistAE's decoder.
+Param-less, xp-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from veles_tpu.ops.conv import _pair
+from veles_tpu.ops.nn_units import ForwardUnit, GradientUnit
+
+
+class Depooling(ForwardUnit):
+    has_params = False
+
+    def __init__(self, workflow=None, kx: int = 2, ky: int = 2,
+                 sliding: Any = None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.kx, self.ky = kx, ky
+        self.sliding = _pair(sliding) if sliding is not None else (ky, kx)
+        if tuple(self.sliding) != (self.ky, self.kx):
+            raise ValueError("Depooling supports non-overlapping "
+                             "windows only (sliding == kernel)")
+
+    def output_shape_for(self, input_shape):
+        b, h, w, c = input_shape
+        return (b, h * self.ky, w * self.kx, c)
+
+    def param_shapes(self, input_shape):
+        return {}
+
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        x = inputs["input"]
+        if isinstance(x, np.ndarray):
+            y = np.repeat(np.repeat(x, self.ky, axis=1), self.kx, axis=2)
+        else:
+            import jax.numpy as jnp
+            y = jnp.repeat(jnp.repeat(x, self.ky, axis=1),
+                           self.kx, axis=2)
+        return {"output": y}
+
+
+class GDDepooling(GradientUnit):
+    def backward_from_saved(self, params, saved, err_output):
+        f = self.forward
+        x, _y = saved
+        b, h, w, c = x.shape
+        e = err_output.reshape(b, h, f.ky, w, f.kx, c)
+        return e.sum(axis=(2, 4)), {}

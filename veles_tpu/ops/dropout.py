@@ -1,0 +1,67 @@
+"""Dropout.
+
+Reference parity: veles/znicz/dropout.py — forward multiplies by a
+Bernoulli mask drawn through the framework PRNG; backward applies the
+same mask.  Inverted scaling (kept units scaled by 1/(1-p)) so eval
+mode is the identity.  The fused TPU path threads a per-step
+``jax.random`` key (stochastic=True); the numpy golden path draws from
+the named 'dropout' stream.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from veles_tpu.ops.nn_units import ForwardUnit, GradientUnit
+
+
+class Dropout(ForwardUnit):
+    has_params = False
+    stochastic = True
+
+    def __init__(self, workflow=None, dropout_ratio: float = 0.5,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.dropout_ratio = dropout_ratio
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def param_shapes(self, input_shape):
+        return {}
+
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        return {"output": inputs["input"]}  # eval mode: identity
+
+    def apply_fwd(self, params, x, rng=None, train=True):
+        if not train:
+            return x, (x, None)
+        keep = 1.0 - self.dropout_ratio
+        if isinstance(x, np.ndarray):
+            from veles_tpu import prng as prng_mod
+            gen = prng_mod.get("dropout").numpy
+            mask = (gen.random(x.shape) < keep).astype(np.float32) / keep
+        else:
+            import jax
+            if rng is None:
+                raise ValueError(f"{self.name}: traced train mode "
+                                 "needs an rng key")
+            mask = jax.random.bernoulli(rng, keep, x.shape) \
+                .astype(x.dtype) / keep
+        return x * mask, (x, mask)
+
+    def eager_rng(self):
+        if self.device is not None and self.device.is_jax:
+            from veles_tpu import prng as prng_mod
+            return prng_mod.get("dropout").next_key()
+        return None
+
+
+class GDDropout(GradientUnit):
+    def backward_from_saved(self, params, saved, err_output):
+        _x, mask = saved
+        if mask is None:
+            return err_output, {}
+        return err_output * mask, {}

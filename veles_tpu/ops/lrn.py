@@ -1,0 +1,75 @@
+"""Local response normalization (across channels).
+
+Reference parity: veles/znicz/normalization.py — AlexNet's LRN:
+``y_i = x_i / (k + alpha * sum_{j in window(i)} x_j^2) ^ beta`` with a
+channel window of size n centered on i, plus its analytic backward.
+
+Implemented once against the shared numpy/jax array API via a padded
+cumulative-sum windowed reduction — no backend-specific code; both the
+golden path and the fused trace run the same lines.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from veles_tpu.ops.nn_units import ForwardUnit, GradientUnit
+
+
+def _xp(x):
+    if isinstance(x, np.ndarray):
+        return np
+    import jax.numpy as jnp
+    return jnp
+
+
+def _window_sum(xp, v, n: int):
+    """Sum of v over a centered channel window of size n (same shape).
+    v: (..., C)."""
+    half = n // 2
+    pad = [(0, 0)] * (v.ndim - 1) + [(half + 1, half)]
+    cs = xp.cumsum(xp.pad(v, pad), axis=-1)
+    c = v.shape[-1]
+    # windowed sum over [i-half, i+half]: cs[i+n] - cs[i]
+    return cs[..., n:n + c] - cs[..., 0:c]
+
+
+class LRNormalizer(ForwardUnit):
+    has_params = False
+
+    def __init__(self, workflow=None, alpha: float = 1e-4,
+                 beta: float = 0.75, n: int = 5, k: float = 2.0,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.alpha, self.beta, self.n, self.k = alpha, beta, n, k
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def param_shapes(self, input_shape):
+        return {}
+
+    def _den(self, xp, x):
+        return self.k + self.alpha * _window_sum(xp, x * x, self.n)
+
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        x = inputs["input"]
+        xp = _xp(x)
+        return {"output": x * self._den(xp, x) ** (-self.beta)}
+
+
+class GDLRNormalizer(GradientUnit):
+    def backward_from_saved(self, params, saved, err_output):
+        f = self.forward
+        x, _y = saved
+        xp = _xp(err_output)
+        den = f._den(xp, x)
+        t = err_output * x * den ** (-f.beta - 1.0)
+        # the window is symmetric, so the transpose windowed sum is the
+        # same windowed sum
+        err_input = (err_output * den ** (-f.beta)
+                     - 2.0 * f.alpha * f.beta * x
+                     * _window_sum(xp, t, f.n))
+        return err_input, {}

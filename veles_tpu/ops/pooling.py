@@ -1,0 +1,205 @@
+"""Pooling units.
+
+Reference parity: veles/znicz/pooling.py (``MaxPooling``,
+``AvgPooling``, ``StochasticPooling``; max stores argmax offsets for
+the backward pass) and veles/znicz/gd_pooling.py (error routing through
+stored offsets / uniform spread).
+
+TPU path: ``lax.reduce_window`` (max/avg) — backward derived with
+``jax.vjp`` (XLA's select-and-scatter).  Stochastic pooling samples a
+window element with probability proportional to its magnitude via the
+Gumbel-max trick inside the trace (deterministic per step key); its
+eval mode is the reference's probability-weighted average.  The numpy
+golden path stores explicit argmax offsets like the reference kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from veles_tpu.ops.conv import _pair, conv_out_size, im2col, col2im
+from veles_tpu.ops.nn_units import ForwardUnit, GradientUnit
+
+
+class PoolingBase(ForwardUnit):
+    has_params = False
+
+    def __init__(self, workflow=None, kx: int = 2, ky: int = 2,
+                 sliding: Any = None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.kx, self.ky = kx, ky
+        self.sliding = _pair(sliding) if sliding is not None else (ky, kx)
+
+    def output_shape_for(self, input_shape):
+        b, h, w, c = input_shape
+        sy, sx = self.sliding
+        return (b, conv_out_size(h, self.ky, 0, sy),
+                conv_out_size(w, self.kx, 0, sx), c)
+
+    def param_shapes(self, input_shape):
+        return {}
+
+    def _windows(self, x: np.ndarray) -> np.ndarray:
+        """(B,OH,OW,ky*kx,C) numpy window view."""
+        p = im2col(x, self.ky, self.kx, (0, 0), self.sliding)
+        b, oh, ow, ky, kx, c = p.shape
+        return p.reshape(b, oh, ow, ky * kx, c)
+
+
+class MaxPooling(PoolingBase):
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        x = inputs["input"]
+        if isinstance(x, np.ndarray):
+            return {"output": self._windows(x).max(axis=3)}
+        from jax import lax
+        return {"output": lax.reduce_window(
+            x, -np.inf, lax.max,
+            (1, self.ky, self.kx, 1),
+            (1,) + tuple(self.sliding) + (1,), "VALID")}
+
+    def apply_fwd(self, params, x, rng=None, train=True):
+        if isinstance(x, np.ndarray):
+            w = self._windows(x)
+            idx = w.argmax(axis=3)          # offsets, reference-style
+            y = np.take_along_axis(w, idx[:, :, :, None, :],
+                                   axis=3)[:, :, :, 0, :]
+            return y, (x, idx)
+        y = self.apply(params, {"input": x})["output"]
+        return y, (x, y)
+
+
+class AvgPooling(PoolingBase):
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        x = inputs["input"]
+        if isinstance(x, np.ndarray):
+            return {"output": self._windows(x).mean(axis=3)}
+        from jax import lax
+        s = lax.reduce_window(
+            x, 0.0, lax.add, (1, self.ky, self.kx, 1),
+            (1,) + tuple(self.sliding) + (1,), "VALID")
+        return {"output": s / float(self.ky * self.kx)}
+
+
+class StochasticPooling(PoolingBase):
+    """Train: sample a window element with p ∝ magnitude (Gumbel-max on
+    log|x|); eval: probability-weighted average (Zeiler & Fergus 2013,
+    the scheme the reference implements)."""
+
+    stochastic = True
+
+    def _probs(self, xp, w):
+        a = xp.abs(w)
+        s = a.sum(axis=3, keepdims=True)
+        return a / xp.maximum(s, 1e-12)
+
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        # eval mode: weighted average
+        x = inputs["input"]
+        if isinstance(x, np.ndarray):
+            w = self._windows(x)
+            return {"output": (w * self._probs(np, w)).sum(axis=3)}
+        import jax.numpy as jnp
+        w = self._jax_windows(x)
+        return {"output": (w * self._probs(jnp, w)).sum(axis=3)}
+
+    def _jax_windows(self, x):
+        """(B,OH,OW,ky*kx,C) via gather-free slicing (static k)."""
+        import jax.numpy as jnp
+        sy, sx = self.sliding
+        b, h, w, c = x.shape
+        oh = conv_out_size(h, self.ky, 0, sy)
+        ow = conv_out_size(w, self.kx, 0, sx)
+        parts = []
+        for iy in range(self.ky):
+            for ix in range(self.kx):
+                parts.append(x[:, iy:iy + oh * sy:sy,
+                               ix:ix + ow * sx:sx, :])
+        return jnp.stack(parts, axis=3)
+
+    def apply_fwd(self, params, x, rng=None, train=True):
+        if not train or rng is None:
+            y = self.apply(params, {"input": x})["output"]
+            return y, (x, y)
+        if isinstance(x, np.ndarray):
+            from veles_tpu import prng as prng_mod
+            gen = prng_mod.get("stochastic_pooling").numpy
+            w = self._windows(x)
+            g = gen.gumbel(size=w.shape).astype(np.float32)
+            idx = (np.log(np.abs(w) + 1e-12) + g).argmax(axis=3)
+            y = np.take_along_axis(w, idx[:, :, :, None, :],
+                                   axis=3)[:, :, :, 0, :]
+            return y, (x, idx)
+        import jax
+        import jax.numpy as jnp
+        w = self._jax_windows(x)
+        g = jax.random.gumbel(rng, w.shape, w.dtype)
+        idx = (jnp.log(jnp.abs(w) + 1e-12) + g).argmax(axis=3)
+        y = jnp.take_along_axis(w, idx[:, :, :, None, :],
+                                axis=3)[:, :, :, 0, :]
+        return y, (x, idx)
+
+
+class GDMaxPooling(GradientUnit):
+    """Routes err_output to the stored argmax offsets (numpy) or via
+    vjp of reduce_window (jax select-and-scatter)."""
+
+    def backward_from_saved(self, params, saved, err_output):
+        f = self.forward
+        x, res = saved
+        if isinstance(err_output, np.ndarray):
+            b, oh, ow, c = err_output.shape
+            ky, kx = f.ky, f.kx
+            idx = res  # argmax offsets saved by apply_fwd
+            cols = np.zeros((b, oh, ow, ky * kx, c), err_output.dtype)
+            np.put_along_axis(cols, idx[:, :, :, None, :],
+                              err_output[:, :, :, None, :], axis=3)
+            err_input = col2im(cols.reshape(b, oh, ow, ky, kx, c),
+                               x.shape, (0, 0), f.sliding)
+            return err_input, {}
+        import jax
+
+        def fwd(xx):
+            if isinstance(f, StochasticPooling):
+                # backward treats the sampled/weighted value like max:
+                # route via the saved indices
+                return self._jax_gather(xx, res)
+            return f.apply({}, {"input": xx})["output"]
+
+        _, vjp = jax.vjp(fwd, x)
+        (err_input,) = vjp(err_output)
+        return err_input, {}
+
+    def _jax_gather(self, x, idx):
+        import jax.numpy as jnp
+        f = self.forward
+        w = f._jax_windows(x) if hasattr(f, "_jax_windows") else None
+        if w is None:
+            raise RuntimeError("stochastic routing needs _jax_windows")
+        return jnp.take_along_axis(w, idx[:, :, :, None, :],
+                                   axis=3)[:, :, :, 0, :]
+
+
+class GDAvgPooling(GradientUnit):
+    def backward_from_saved(self, params, saved, err_output):
+        f = self.forward
+        x, _ = saved
+        scale = 1.0 / float(f.ky * f.kx)
+        if isinstance(err_output, np.ndarray):
+            b, oh, ow, c = err_output.shape
+            cols = np.broadcast_to(
+                (err_output * scale)[:, :, :, None, :],
+                (b, oh, ow, f.ky * f.kx, c))
+            err_input = col2im(
+                cols.reshape(b, oh, ow, f.ky, f.kx, c),
+                x.shape, (0, 0), f.sliding)
+            return err_input, {}
+        import jax
+
+        def fwd(xx):
+            return f.apply({}, {"input": xx})["output"]
+
+        _, vjp = jax.vjp(fwd, x)
+        (err_input,) = vjp(err_output)
+        return err_input, {}
