@@ -1,0 +1,82 @@
+"""CLI front end: python -m veles_tpu workflow.py config.py root.k=v
+(reference: veles/__main__.py contract)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(args, cwd=REPO, timeout=300):
+    env = dict(os.environ)
+    return subprocess.run(
+        [sys.executable, "-m", "veles_tpu"] + args,
+        capture_output=True, text=True, cwd=cwd, env=env,
+        timeout=timeout)
+
+
+@pytest.fixture
+def workflow_file(tmp_path):
+    p = tmp_path / "wf.py"
+    p.write_text(textwrap.dedent("""
+        import json
+        from veles_tpu.config import root
+        from veles_tpu.models import mnist
+
+        def run(launcher):
+            launcher.create_workflow(
+                mnist.create_workflow,
+                loader={"minibatch_size": 25,
+                        "n_train": int(root.test.n_train),
+                        "n_valid": 50},
+                decision={"max_epochs": 2})
+            launcher.initialize()
+            launcher.run()
+            d = launcher.workflow.decision
+            tr = [h["loss"] for h in d.history if h["class"] == "train"]
+            print("RESULT " + json.dumps({
+                "train_losses": tr,
+                "epochs": launcher.workflow.loader.epoch_number}))
+    """))
+    return str(p)
+
+
+@pytest.fixture
+def config_file(tmp_path):
+    p = tmp_path / "cfg.py"
+    p.write_text("root.test.n_train = 100\n")
+    return str(p)
+
+
+class TestCLI:
+    def test_workflow_with_config_and_override(self, workflow_file,
+                                               config_file):
+        r = run_cli([workflow_file, config_file, "root.test.n_train=150",
+                     "-b", "cpu"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith("RESULT ")][0]
+        data = json.loads(line[len("RESULT "):])
+        assert data["epochs"] == 2
+        assert data["train_losses"][-1] < data["train_losses"][0]
+
+    def test_numpy_backend_flag(self, workflow_file, config_file):
+        r = run_cli([workflow_file, config_file, "-b", "numpy"])
+        assert r.returncode == 0, r.stderr[-2000:]
+
+    def test_dump_config(self, workflow_file, config_file):
+        r = run_cli([workflow_file, config_file, "--dump-config"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "n_train = 100" in r.stdout
+
+    def test_bad_workflow_file(self, tmp_path):
+        p = tmp_path / "empty.py"
+        p.write_text("x = 1\n")
+        r = run_cli([str(p)])
+        assert r.returncode == 2
+        assert "defines neither" in r.stderr

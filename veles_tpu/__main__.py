@@ -1,0 +1,90 @@
+"""CLI entry point.
+
+Reference parity: veles/__main__.py —
+``python -m veles_tpu [flags] workflow.py [config.py ...] [root.k=v ...]``
+
+The workflow file must expose ``run(launcher)`` (builds, initializes
+and runs its workflow) or ``create_workflow(launcher) -> Workflow``
+(the launcher then drives initialize/run).  Config files are python
+executed against the global ``root``; trailing ``root.path=value``
+arguments override both.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from veles_tpu.config import parse_overrides
+from veles_tpu.launcher import (Launcher, apply_config_file,
+                                load_workflow_module)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="veles_tpu",
+        description="TPU-native dataflow ML framework "
+                    "(VELES-capability rebuild)")
+    p.add_argument("files", nargs="+",
+                   help="workflow.py [config.py ...]")
+    p.add_argument("-b", "--backend", default="auto",
+                   choices=["auto", "tpu", "jax", "cpu", "numpy"],
+                   help="execution backend (default: auto)")
+    p.add_argument("-s", "--seed", type=int, default=1234)
+    p.add_argument("--snapshot", default=None,
+                   help="resume from a snapshot file")
+    p.add_argument("--dp", type=int, default=None,
+                   help="data-parallel ways over the device mesh")
+    p.add_argument("--multihost", action="store_true",
+                   help="call jax.distributed.initialize() "
+                        "(multi-host SPMD over DCN+ICI)")
+    p.add_argument("--master-address", default=None,
+                   help="run as zmq slave of this master (DCN compat)")
+    p.add_argument("--listen-address", default=None,
+                   help="run as zmq master listening here (DCN compat)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("--dump-config", action="store_true",
+                   help="print the effective config tree and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # root.* overrides can appear anywhere; apply AFTER config files,
+    # so collect them first but apply later.
+    overrides = [a for a in argv if a.startswith("root.") and "=" in a]
+    rest = [a for a in argv if a not in overrides]
+    args = build_parser().parse_args(rest)
+
+    workflow_file, *config_files = args.files
+    for cf in config_files:
+        apply_config_file(cf)
+    parse_overrides(overrides)
+
+    launcher = Launcher(
+        backend=args.backend, seed=args.seed, snapshot=args.snapshot,
+        dp=args.dp, master_address=args.master_address,
+        listen_address=args.listen_address, multihost=args.multihost,
+        verbose=args.verbose)
+
+    if args.dump_config:
+        from veles_tpu.config import root
+        root.print_()
+        return 0
+
+    mod = load_workflow_module(workflow_file)
+    if hasattr(mod, "run"):
+        mod.run(launcher)
+    elif hasattr(mod, "create_workflow"):
+        launcher.create_workflow(getattr(mod, "create_workflow"))
+        launcher.initialize()
+        launcher.run()
+    else:
+        print(f"{workflow_file}: defines neither run(launcher) nor "
+              "create_workflow(launcher)", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

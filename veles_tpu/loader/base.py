@@ -109,7 +109,13 @@ class Loader(Unit, Distributable):
             raise ValueError(f"{self.name}: load_data produced no samples")
         self._present_classes = [c for c in (TEST, VALID, TRAIN)
                                  if self.class_lengths[c] > 0]
-        self._reset_epoch()
+        # Snapshot resume: the pickled epoch order/cursor is mid-stream
+        # state — reshuffling here would diverge from an uninterrupted
+        # run AND double-consume the PRNG stream.  Only build a fresh
+        # order when none matches the (re)loaded data.
+        if any(len(self._order[c]) != self.class_lengths[c]
+               for c in (TEST, VALID, TRAIN)):
+            self._reset_epoch()
         # Allocate static-shaped minibatch vectors.
         mb = self.max_minibatch_size
         self.minibatch_indices.mem = np.zeros(mb, np.int32)

@@ -1,0 +1,93 @@
+"""Loaders over the deterministic synthetic datasets (and real files
+when present).  Regenerate in ``load_data`` so snapshots stay small —
+the generator args, not the arrays, are pickled."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from veles_tpu import datasets
+from veles_tpu.loader.base import TEST, TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+
+class SyntheticClassificationLoader(FullBatchLoader):
+    """Procedural image-classification dataset, fully determined by the
+    constructor args (veles_tpu/datasets.py)."""
+
+    def __init__(self, workflow=None, n_train: int = 1000,
+                 n_valid: int = 200, n_test: int = 0,
+                 shape: Tuple[int, ...] = (28, 28, 1),
+                 n_classes: int = 10, noise: float = 0.4,
+                 max_shift: int = 2, seed: int = 20260729,
+                 targets_from_data: bool = False,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.gen_args = dict(n_train=n_train, n_valid=n_valid,
+                             n_test=n_test, shape=tuple(shape),
+                             n_classes=n_classes, noise=noise,
+                             max_shift=max_shift, seed=seed)
+        self.targets_from_data = targets_from_data
+
+    def load_data(self) -> None:
+        a = self.gen_args
+        train, valid, test = datasets.synthetic_classification(
+            a["n_train"], a["n_valid"], a["shape"],
+            n_classes=a["n_classes"], noise=a["noise"],
+            max_shift=a["max_shift"], seed=a["seed"],
+            n_test=a["n_test"])
+        xs, ys = [], []
+        for klass, split in ((TEST, test), (VALID, valid),
+                             (TRAIN, train)):
+            if split is None:
+                self.class_lengths[klass] = 0
+                continue
+            self.class_lengths[klass] = len(split[0])
+            xs.append(split[0])
+            ys.append(split[1])
+        self.original_data.mem = np.concatenate(xs, axis=0)
+        self.original_labels.mem = \
+            np.concatenate(ys, axis=0).astype(np.int32)
+        if self.targets_from_data:
+            self.original_targets.mem = self.original_data.mem
+
+    def __getstate__(self) -> dict:
+        d = super().__getstate__()
+        # drop the bulky arrays; load_data regenerates them on resume
+        for key in ("original_data", "original_labels",
+                    "original_targets"):
+            vec = d.get(key)
+            if vec is not None:
+                import copy
+                vec = copy.copy(vec)
+                vec.__setstate__({"name": vec.name, "mem": None})
+                d[key] = vec
+        return d
+
+
+class MnistLoader(SyntheticClassificationLoader):
+    """Real MNIST IDX files if pre-placed under the data dir, else the
+    synthetic 28x28x1 stand-in (this image has no datasets and no
+    network — SURVEY.md §0)."""
+
+    def __init__(self, workflow=None, n_train: int = 60000,
+                 n_valid: int = 10000, **kwargs: Any) -> None:
+        super().__init__(workflow, n_train=n_train, n_valid=n_valid,
+                         shape=(28, 28, 1), seed=28281, **kwargs)
+
+    def load_data(self) -> None:
+        real = datasets.try_load_real_mnist()
+        if real is None:
+            super().load_data()
+            return
+        (tx, ty), (vx, vy) = real
+        self.class_lengths[TEST] = 0
+        self.class_lengths[VALID] = len(vx)
+        self.class_lengths[TRAIN] = len(tx)
+        self.original_data.mem = np.concatenate([vx, tx], axis=0)
+        self.original_labels.mem = np.concatenate(
+            [vy, ty], axis=0).astype(np.int32)
+        if self.targets_from_data:
+            self.original_targets.mem = self.original_data.mem

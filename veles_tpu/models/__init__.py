@@ -1,0 +1,27 @@
+"""Model zoo: the five BASELINE.json benchmark workflows
+(reference: veles/znicz/samples/).
+
+Each module exposes ``create_workflow(launcher)`` and ``run(launcher)``
+and reads its parameters from the global config tree under
+``root.<model>`` (defaults merged in, CLI ``root.x=y`` overrides win).
+"""
+
+from veles_tpu.config import root
+
+
+def model_config(name: str, defaults: dict):
+    """Merge defaults under root.<name> without clobbering overrides."""
+    node = getattr(root, name)
+    merged = dict_merge(defaults, node.todict())
+    node.update(merged)
+    return node
+
+
+def dict_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = dict_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

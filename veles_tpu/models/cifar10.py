@@ -1,0 +1,67 @@
+"""CIFAR-10 convolutional workflow with LR policy + weight decay.
+
+Reference parity: veles/znicz/samples/CIFAR10 (BASELINE config #3,
+"CIFAR-10 conv workflow with LR policy + weight decay"): conv/pool
+stack with ReLU, inverse-decay learning-rate schedule, L2 weight decay.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.loader.synthetic import SyntheticClassificationLoader
+from veles_tpu.models import model_config
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+GD = {"learning_rate": 0.02, "weight_decay": 0.0005,
+      "gradient_moment": 0.9}
+
+DEFAULTS = {
+    "loader": {"minibatch_size": 100, "n_train": 50000,
+               "n_valid": 10000, "shape": (32, 32, 3),
+               "noise": 0.5, "seed": 32323},
+    "layers": [
+        {"type": "conv_relu",
+         "->": {"n_kernels": 32, "kx": 5, "ky": 5, "padding": 2},
+         "<-": GD},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": 2},
+         "<-": {}},
+        {"type": "conv_relu",
+         "->": {"n_kernels": 32, "kx": 5, "ky": 5, "padding": 2},
+         "<-": GD},
+        {"type": "avg_pooling", "->": {"kx": 3, "ky": 3, "sliding": 2},
+         "<-": {}},
+        {"type": "conv_relu",
+         "->": {"n_kernels": 64, "kx": 5, "ky": 5, "padding": 2},
+         "<-": GD},
+        {"type": "avg_pooling", "->": {"kx": 3, "ky": 3, "sliding": 2},
+         "<-": {}},
+        {"type": "softmax", "->": {"output_sample_shape": 10},
+         "<-": GD},
+    ],
+    "lr_adjust": {"policy_name": "inv",
+                  "policy_kwargs": {"gamma": 0.0001, "power": 0.75},
+                  "by": "iteration"},
+    "decision": {"max_epochs": 20, "fail_iterations": 50},
+    "snapshotter": None,
+}
+
+
+def create_workflow(launcher, **overrides):
+    cfg = model_config("cifar10", DEFAULTS).todict()
+    cfg.update(overrides)
+    w = StandardWorkflow(
+        loader_factory=lambda wf: SyntheticClassificationLoader(
+            wf, name="loader", **cfg["loader"]),
+        layers=cfg["layers"],
+        loss_function="softmax",
+        decision_config=cfg["decision"],
+        snapshotter_config=cfg.get("snapshotter"),
+        lr_adjust_config=cfg.get("lr_adjust"),
+        name="Cifar10Workflow")
+    launcher.workflow = w
+    return w
+
+
+def run(launcher):
+    launcher.create_workflow(create_workflow)
+    launcher.initialize()
+    launcher.run()

@@ -1,0 +1,73 @@
+"""Kohonen SOM workflow (BASELINE config #5b).
+
+Reference parity: veles/znicz/samples Kohonen demo — an unsupervised
+self-organizing map trained on feature vectors; Decision stops on max
+epochs; the tracked metric is the quantization error.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from veles_tpu.loader.synthetic import SyntheticClassificationLoader
+from veles_tpu.models import model_config
+from veles_tpu.mutable import Bool
+from veles_tpu.ops.decision import DecisionGD
+from veles_tpu.ops.kohonen import KohonenForward, KohonenTrainer
+from veles_tpu.ops.nn_units import NNWorkflow
+from veles_tpu.workflow import Repeater
+
+DEFAULTS = {
+    "loader": {"minibatch_size": 100, "n_train": 5000, "n_valid": 0,
+               "shape": (8, 8, 1), "n_classes": 10, "seed": 888},
+    "som_shape": (8, 8),
+    "trainer": {"alpha0": 0.3, "alpha_min": 0.01, "decay_epochs": 15},
+    "decision": {"max_epochs": 15},
+}
+
+
+class KohonenWorkflow(NNWorkflow):
+    def __init__(self, workflow=None, loader_cfg=None, som_shape=(8, 8),
+                 trainer_cfg=None, decision_cfg=None,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.repeater = Repeater(self, name="repeater")
+        self.loader = SyntheticClassificationLoader(
+            self, name="loader", **(loader_cfg or {}))
+        self.forward = KohonenForward(self, shape=som_shape,
+                                      name="kohonen_fwd")
+        self.forward.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.trainer = KohonenTrainer(self, forward=self.forward,
+                                      name="kohonen_trainer",
+                                      **(trainer_cfg or {}))
+        self.trainer.loader = self.loader
+        self.decision = DecisionGD(self, name="decision",
+                                   **(decision_cfg or {}))
+        self.decision.loader = self.loader
+        self.decision.evaluator = self.trainer  # publishes n_err/loss/count
+
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.trainer.link_from(self.loader)
+        self.decision.link_from(self.trainer)
+        self.repeater.link_from(self.decision)
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+
+def create_workflow(launcher, **overrides):
+    cfg = model_config("kohonen", DEFAULTS).todict()
+    cfg.update(overrides)
+    w = KohonenWorkflow(
+        loader_cfg=cfg["loader"], som_shape=tuple(cfg["som_shape"]),
+        trainer_cfg=cfg["trainer"], decision_cfg=cfg["decision"],
+        name="KohonenWorkflow")
+    launcher.workflow = w
+    return w
+
+
+def run(launcher):
+    launcher.create_workflow(create_workflow)
+    launcher.initialize()
+    launcher.run()

@@ -1,0 +1,46 @@
+"""MNIST All2All fully-connected workflow.
+
+Reference parity: veles/znicz/samples/MNIST (BASELINE config #1,
+"MNIST All2All fully-connected workflow (numpy/CPU backend)"):
+FullBatch MNIST -> All2AllTanh(100) -> All2AllSoftmax(10) ->
+EvaluatorSoftmax -> GD chain -> Decision loop -> Snapshotter.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.loader.synthetic import MnistLoader
+from veles_tpu.models import model_config
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+DEFAULTS = {
+    "loader": {"minibatch_size": 60, "n_train": 60000, "n_valid": 10000},
+    "layers": [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 100},
+         "<-": {"learning_rate": 0.1, "weight_decay": 0.0}},
+        {"type": "softmax", "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.1, "weight_decay": 0.0}},
+    ],
+    "decision": {"max_epochs": 10, "fail_iterations": 50},
+    "snapshotter": None,
+}
+
+
+def create_workflow(launcher, **overrides):
+    cfg = model_config("mnist", DEFAULTS).todict()
+    cfg.update(overrides)
+    w = StandardWorkflow(
+        loader_factory=lambda wf: MnistLoader(
+            wf, name="loader", **cfg["loader"]),
+        layers=cfg["layers"],
+        loss_function="softmax",
+        decision_config=cfg["decision"],
+        snapshotter_config=cfg.get("snapshotter"),
+        name="MnistWorkflow")
+    launcher.workflow = w
+    return w
+
+
+def run(launcher):
+    launcher.create_workflow(create_workflow)
+    launcher.initialize()
+    launcher.run()

@@ -1,0 +1,56 @@
+"""MNIST7 convolutional workflow.
+
+Reference parity: veles/znicz/samples MNIST7 (BASELINE config #2,
+"MNIST7 conv workflow (znicz Conv + Pooling + GD units)"): a small
+conv net over 28x28 digits — ConvTanh/MaxPooling stages feeding
+fully-connected layers.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.loader.synthetic import MnistLoader
+from veles_tpu.models import model_config
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+GD = {"learning_rate": 0.03, "weight_decay": 0.0005,
+      "gradient_moment": 0.9}
+
+DEFAULTS = {
+    "loader": {"minibatch_size": 100, "n_train": 60000,
+               "n_valid": 10000},
+    "layers": [
+        {"type": "conv_tanh",
+         "->": {"n_kernels": 25, "kx": 5, "ky": 5}, "<-": GD},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}, "<-": {}},
+        {"type": "conv_tanh",
+         "->": {"n_kernels": 50, "kx": 5, "ky": 5}, "<-": GD},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}, "<-": {}},
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 100},
+         "<-": GD},
+        {"type": "softmax", "->": {"output_sample_shape": 10},
+         "<-": GD},
+    ],
+    "decision": {"max_epochs": 12, "fail_iterations": 25},
+    "snapshotter": None,
+}
+
+
+def create_workflow(launcher, **overrides):
+    cfg = model_config("mnist7", DEFAULTS).todict()
+    cfg.update(overrides)
+    w = StandardWorkflow(
+        loader_factory=lambda wf: MnistLoader(
+            wf, name="loader", **cfg["loader"]),
+        layers=cfg["layers"],
+        loss_function="softmax",
+        decision_config=cfg["decision"],
+        snapshotter_config=cfg.get("snapshotter"),
+        name="Mnist7Workflow")
+    launcher.workflow = w
+    return w
+
+
+def run(launcher):
+    launcher.create_workflow(create_workflow)
+    launcher.initialize()
+    launcher.run()

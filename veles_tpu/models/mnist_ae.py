@@ -1,0 +1,54 @@
+"""MnistAE: convolutional autoencoder (BASELINE config #5a).
+
+Reference parity: veles/znicz/samples/MnistAE — encoder
+(ConvTanh + MaxPooling) and mirrored decoder (Depooling + Deconv),
+trained with MSE against the input image.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.loader.synthetic import MnistLoader
+from veles_tpu.models import model_config
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+GD = {"learning_rate": 0.005, "weight_decay": 0.0,
+      "gradient_moment": 0.9}
+
+DEFAULTS = {
+    "loader": {"minibatch_size": 100, "n_train": 60000,
+               "n_valid": 10000},
+    "layers": [
+        {"type": "conv_tanh",
+         "->": {"n_kernels": 9, "kx": 5, "ky": 5, "padding": 2},
+         "<-": GD},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}, "<-": {}},
+        {"type": "depooling", "->": {"kx": 2, "ky": 2}, "<-": {}},
+        {"type": "deconv",
+         "->": {"n_kernels": 1, "kx": 5, "ky": 5, "padding": 2},
+         "<-": GD},
+    ],
+    "decision": {"max_epochs": 10, "fail_iterations": 20},
+    "snapshotter": None,
+}
+
+
+def create_workflow(launcher, **overrides):
+    cfg = model_config("mnist_ae", DEFAULTS).todict()
+    cfg.update(overrides)
+    loader_cfg = dict(cfg["loader"])
+    w = StandardWorkflow(
+        loader_factory=lambda wf: MnistLoader(
+            wf, name="loader", targets_from_data=True, **loader_cfg),
+        layers=cfg["layers"],
+        loss_function="mse",
+        decision_config=cfg["decision"],
+        snapshotter_config=cfg.get("snapshotter"),
+        name="MnistAEWorkflow")
+    launcher.workflow = w
+    return w
+
+
+def run(launcher):
+    launcher.create_workflow(create_workflow)
+    launcher.initialize()
+    launcher.run()

@@ -53,6 +53,10 @@ class Deconv(ForwardUnit):
             shapes["bias"] = (self.n_kernels,)
         return shapes
 
+    def weight_fan_in(self, shape):
+        ky, kx, _n_out, c_in = shape
+        return ky * kx * c_in
+
     def pre_activation(self, params, x):
         if isinstance(x, np.ndarray):
             b, h, w, c_in = x.shape

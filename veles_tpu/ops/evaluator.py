@@ -143,8 +143,11 @@ class EvaluatorSoftmax(EvaluatorBase):
 class EvaluatorMSE(EvaluatorBase):
     """Mean-squared-error evaluator (autoencoders, regression).
 
-    loss_sum = sum over valid rows of 0.5 * ||y - t||^2;
-    err_output = (y - t) * mask / n_valid.
+    Normalized per ELEMENT, not per row: with D = features/sample,
+    loss_sum = sum over valid rows of 0.5 * ||y - t||^2 / D and
+    err_output = (y - t) * mask / (n_valid * D).  Per-row-only
+    normalization makes the gradient scale with the sample size (784x
+    for MNIST images) and blows up any reasonable learning rate.
     """
 
     def __init__(self, workflow=None, **kwargs: Any) -> None:
@@ -157,17 +160,18 @@ class EvaluatorMSE(EvaluatorBase):
         bshape = (-1,) + (1,) * (diff.ndim - 1)
         m = mask.reshape(bshape)
         n = mask.sum()
+        d = float(np.prod(output.shape[1:]))
         if isinstance(output, np.ndarray):
-            err = diff * m / np.maximum(n, 1.0)
-            per_row = 0.5 * (diff * diff).reshape(len(diff), -1).sum(-1)
+            err = diff * m / np.maximum(n * d, 1.0)
+            per_row = 0.5 * (diff * diff).reshape(len(diff), -1).sum(-1) / d
             loss_sum = (per_row * mask).sum()
             return {"err_output": err.astype(np.float32),
                     "n_err": np.float32(0.0),
                     "loss_sum": np.float32(loss_sum),
                     "count": np.float32(n)}
         import jax.numpy as jnp
-        err = diff * m / jnp.maximum(n, 1.0)
-        per_row = 0.5 * (diff * diff).reshape(len(diff), -1).sum(-1)
+        err = diff * m / jnp.maximum(n * d, 1.0)
+        per_row = 0.5 * (diff * diff).reshape(len(diff), -1).sum(-1) / d
         loss_sum = (per_row * mask).sum()
         return {"err_output": err.astype(jnp.float32),
                 "n_err": jnp.float32(0.0),

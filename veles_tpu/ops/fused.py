@@ -53,7 +53,9 @@ class FusedStepRunner(AcceleratedUnit):
         self._opt: Optional[Dict[str, Dict[str, Any]]] = None
         self._rng_counter = 0
         self._conf_handles: List[Any] = []
-        self.lr_scale = 1.0  # lr_adjust policies write this
+        #: per-GD lr multipliers (traced arg — lr_adjust writes these
+        #: without triggering a retrace)
+        self.lr_scales = [1.0] * len(self.gds)
 
     _unpicklable = AcceleratedUnit._unpicklable + (
         "_train_step", "_eval_step", "_params", "_opt")
@@ -131,7 +133,7 @@ class FusedStepRunner(AcceleratedUnit):
             return x, t
 
         def train_step(params, opt, dataset, target_store, indices, mask,
-                       lr_scale, rng_counter):
+                       lr_scales, rng_counter):
             x, target = gather(dataset, target_store, indices)
             out, residuals = forward_pass(params, x, rng_counter, True)
             m = metrics_of(out, target, mask)
@@ -141,17 +143,16 @@ class FusedStepRunner(AcceleratedUnit):
             for i in range(n_fwd - 1, -1, -1):
                 f, gd = forwards[i], gds[i]
                 if gd is None:
-                    # param-less layer: still route the error back
-                    err = f.route_err(params[f.name], residuals[i], err) \
-                        if hasattr(f, "route_err") else err
                     continue
                 err_in, grads = gd.backward_from_saved(
                     params[f.name], residuals[i], err)
-                p, v = gd.update_params(params[f.name], grads,
-                                        opt.get(gd.name, {}), lr_scale)
-                new_params[f.name] = p
-                if gd.name in opt:
-                    new_opt[gd.name] = v
+                if grads:
+                    p, v = gd.update_params(params[f.name], grads,
+                                            opt.get(gd.name, {}),
+                                            lr_scales[i])
+                    new_params[f.name] = p
+                    if gd.name in opt:
+                        new_opt[gd.name] = v
                 err = err_in
             return new_params, new_opt, m
 
@@ -192,7 +193,8 @@ class FusedStepRunner(AcceleratedUnit):
         if ld.minibatch_class == TRAIN:
             self._params, self._opt, m = self._train_step(
                 self._params, self._opt, dataset, targets, indices, mask,
-                float(self.lr_scale), self._rng_counter)
+                np.asarray(self.lr_scales, np.float32),
+                self._rng_counter)
             self._scatter_params(self._params, self._opt)
         else:
             m, out = self._eval_step(self._params, dataset, targets,

@@ -1,0 +1,211 @@
+"""Kohonen self-organizing map units (BASELINE config #5b).
+
+Reference parity: veles/znicz/kohonen.py — a ``KohonenForward`` unit
+(distances of each sample to every prototype on an sx*sy grid, winner
+= argmin) and a trainer unit applying the classic SOM update with a
+gaussian neighborhood and exponentially decaying learning rate/radius.
+Unsupervised: no gradient-descent chain; the trainer IS the weight
+update.
+
+TPU path: one jitted step computes distances, winners, the
+batch-summed neighborhood update and the new prototype matrix, with
+the prototype buffer donated.  Numpy twin shares the same array-API
+code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu import prng
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.memory import Vector
+
+
+def grid_coords(sx: int, sy: int, xp=np):
+    ys, xs = xp.meshgrid(xp.arange(sy), xp.arange(sx), indexing="ij")
+    return xp.stack([ys.reshape(-1), xs.reshape(-1)], 1)  # (N, 2)
+
+
+def som_step(weights, x_flat, coords, alpha, sigma):
+    """Pure SOM update (shared numpy/jax array API).
+
+    weights: (N, D) prototypes; x_flat: (B, D); coords: (N, 2) grid;
+    returns (new_weights, winners, quantization_error_sum).
+    """
+    if isinstance(weights, np.ndarray):
+        xp = np
+    else:
+        import jax.numpy as xp
+    d2 = ((x_flat * x_flat).sum(1, keepdims=True)
+          - 2.0 * x_flat @ weights.T
+          + (weights * weights).sum(1)[None, :])      # (B, N)
+    winners = d2.argmin(1)                             # (B,)
+    qe_sum = xp.sqrt(xp.maximum(
+        d2[xp.arange(x_flat.shape[0]), winners], 0.0)).sum()
+    wc = coords[winners]                               # (B, 2)
+    gd2 = ((wc[:, None, :] - coords[None, :, :]) ** 2) \
+        .sum(-1).astype(weights.dtype)                 # (B, N)
+    h = xp.exp(-gd2 / (2.0 * sigma * sigma))           # (B, N)
+    num = h.T @ x_flat                                 # (N, D)
+    den = h.sum(0)[:, None]                            # (N, 1)
+    delta = alpha * (num - den * weights) / x_flat.shape[0]
+    return weights + delta, winners, qe_sum
+
+
+class KohonenForward(AcceleratedUnit):
+    """Distances + winners for the current minibatch (inference side)."""
+
+    def __init__(self, workflow=None, shape: Tuple[int, int] = (8, 8),
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.sy, self.sx = shape
+        self.input = Vector(name=f"{self.name}.input")
+        self.weights = Vector(name=f"{self.name}.weights")
+        self.output = Vector(name=f"{self.name}.output")   # distances
+        self.winners = Vector(name=f"{self.name}.winners")
+
+    @property
+    def n_neurons(self) -> int:
+        return self.sx * self.sy
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        d = int(np.prod(self.input.shape[1:]))
+        if not self.weights:
+            gen = prng.get("weights").numpy
+            self.weights.mem = gen.uniform(
+                -0.1, 0.1, (self.n_neurons, d)).astype(np.float32)
+        self.weights.initialize(device)
+        self.input.initialize(device)
+
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        x = inputs["input"]
+        x = x.reshape(x.shape[0], -1)
+        w = params["weights"]
+        d2 = ((x * x).sum(1, keepdims=True) - 2.0 * x @ w.T
+              + (w * w).sum(1)[None, :])
+        return {"output": d2, "winners": d2.argmin(1)}
+
+    def gather_params(self):
+        return {"weights": self.weights.unmap()}
+
+    def run(self) -> None:
+        numpy_mode = self.device is None or not self.device.is_jax
+        if numpy_mode:
+            out = self.apply({"weights": self.weights.map_read()},
+                             {"input": self.input.map_read()})
+            self.output.reset(out["output"])
+            self.winners.reset(out["winners"])
+        else:
+            if self._compiled is None:
+                self._compiled = self.device.compile(self.apply)
+            out = self._compiled(self.gather_params(),
+                                 {"input": self.input.unmap()})
+            self.output.devmem = out["output"]
+            self.winners.devmem = out["winners"]
+
+
+class KohonenTrainer(AcceleratedUnit):
+    """The SOM update; owns the schedule state.
+
+    alpha(t) and sigma(t) decay exponentially from their initial values
+    to their final values over ``decay_epochs`` (reference: gravity /
+    radius decay in znicz kohonen trainer).
+    """
+
+    def __init__(self, workflow=None,
+                 forward: Optional[KohonenForward] = None,
+                 alpha0: float = 0.3, alpha_min: float = 0.01,
+                 sigma0: Optional[float] = None, sigma_min: float = 0.5,
+                 decay_epochs: int = 20, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.forward = forward
+        self.alpha0, self.alpha_min = alpha0, alpha_min
+        self.sigma0 = sigma0
+        self.sigma_min = sigma_min
+        self.decay_epochs = decay_epochs
+        self.loader = None
+        # metrics published for Decision (same contract as evaluators)
+        self.n_err = Vector(name=f"{self.name}.n_err")
+        self.loss = Vector(name=f"{self.name}.loss")
+        self.count = Vector(name=f"{self.name}.count")
+        self._coords_host = None
+        self._coords_dev = None
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        f = self.forward
+        if self.sigma0 is None:
+            self.sigma0 = max(f.sx, f.sy) / 2.0
+        self._coords_host = grid_coords(f.sx, f.sy).astype(np.float32)
+        if device is not None and device.is_jax:
+            self._coords_dev = device.put(self._coords_host)
+
+    def schedule(self) -> Tuple[float, float]:
+        t = min(getattr(self.loader, "epoch_number", 0),
+                self.decay_epochs) / max(self.decay_epochs, 1)
+        alpha = self.alpha0 * (self.alpha_min / self.alpha0) ** t
+        sigma = self.sigma0 * (self.sigma_min / self.sigma0) ** t
+        return alpha, sigma
+
+    def run(self) -> None:
+        if self.loader is not None and \
+                self.loader.minibatch_class != TRAIN:
+            # evaluation classes: quantization error only
+            self._eval_only()
+            return
+        f = self.forward
+        alpha, sigma = self.schedule()
+        numpy_mode = self.device is None or not self.device.is_jax
+        if numpy_mode:
+            x = f.input.map_read().reshape(len(f.input), -1)
+            w, winners, qe = som_step(f.weights.map_read(), x,
+                                      self._coords_host,
+                                      np.float32(alpha),
+                                      np.float32(sigma))
+            f.weights.map_invalidate()[:] = w
+            self.loss.reset(np.float32([qe]))
+        else:
+            if self._compiled is None:
+                self._compiled = self.device.compile(
+                    som_step, donate_argnums=(0,))
+            w, winners, qe = self._compiled(
+                f.weights.unmap(),
+                f.input.unmap().reshape(len(f.input), -1),
+                self._coords_dev,
+                np.float32(alpha), np.float32(sigma))
+            f.weights.devmem = w
+            self.loss.devmem = qe
+        n = len(f.input)
+        self.n_err.reset(np.float32([0.0]))
+        self.count.reset(np.float32([n]))
+
+    def _eval_only(self) -> None:
+        f = self.forward
+        numpy_mode = self.device is None or not self.device.is_jax
+        if numpy_mode:
+            out = f.apply({"weights": f.weights.map_read()},
+                          {"input": f.input.map_read()})
+            d2 = out["output"]
+            qe = np.sqrt(np.maximum(d2.min(1), 0)).sum()
+            self.loss.reset(np.float32([qe]))
+        else:
+            import jax.numpy as jnp
+
+            def eval_fn(wts, x):
+                out = f.apply({"weights": wts}, {"input": x})
+                return jnp.sqrt(jnp.maximum(out["output"].min(1), 0)).sum()
+
+            if getattr(self, "_eval_compiled", None) is None:
+                self._eval_compiled = self.device.compile(eval_fn)
+            self.loss.devmem = self._eval_compiled(f.weights.unmap(),
+                                                   f.input.unmap())
+        self.n_err.reset(np.float32([0.0]))
+        self.count.reset(np.float32([len(f.input)]))
+
+    _unpicklable = AcceleratedUnit._unpicklable + (
+        "_coords_dev", "_eval_compiled")

@@ -1,0 +1,108 @@
+"""Learning-rate schedules.
+
+Reference parity: veles/znicz/lr_adjust.py — policies (fixed, step,
+exponential, inverse) applied to the GD units' learning rates as
+training progresses (BASELINE config #3 "CIFAR-10 ... LR policy").
+
+TPU adaptation: in fused mode the per-GD base rates are trace-time
+constants, so schedules flow through the runner's ``lr_scales`` vector
+— a traced argument, scale_i(t) = lr_i(t) / lr_i(0) — and no retrace
+ever happens.  In eager mode the unit writes absolute rates into the
+GD units directly, like the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+from veles_tpu.units import Unit
+
+PolicyFn = Callable[[float, int], float]  # (base_lr, t) -> lr
+
+_policies: Dict[str, Callable[..., PolicyFn]] = {}
+
+
+def policy(name: str):
+    def deco(fn):
+        _policies[name] = fn
+        return fn
+    return deco
+
+
+@policy("fixed")
+def fixed_policy() -> PolicyFn:
+    return lambda base, t: base
+
+
+@policy("step")
+def step_policy(gamma: float = 0.1, step: int = 10) -> PolicyFn:
+    return lambda base, t: base * gamma ** (t // step)
+
+
+@policy("exp")
+def exp_policy(gamma: float = 0.95) -> PolicyFn:
+    return lambda base, t: base * gamma ** t
+
+
+@policy("inv")
+def inv_policy(gamma: float = 1e-4, power: float = 0.75) -> PolicyFn:
+    return lambda base, t: base * (1.0 + gamma * t) ** (-power)
+
+
+@policy("arbitrary")
+def arbitrary_policy(points: List = ()) -> PolicyFn:
+    """Piecewise-constant: points = [(t_from, lr), ...] sorted."""
+    pts = sorted(points)
+
+    def fn(base, t):
+        lr = base
+        for t0, v in pts:
+            if t >= t0:
+                lr = v
+        return lr
+    return fn
+
+
+def make_policy(name: str, **kwargs: Any) -> PolicyFn:
+    if name not in _policies:
+        raise ValueError(f"unknown lr policy {name!r}; "
+                         f"have {sorted(_policies)}")
+    return _policies[name](**kwargs)
+
+
+class LearningRateAdjust(Unit):
+    """Applies a schedule to all (or selected) GD units each epoch or
+    iteration.  Sits between the loader and the compute step."""
+
+    def __init__(self, workflow=None, policy_name: str = "fixed",
+                 policy_kwargs: Optional[dict] = None,
+                 by: str = "epoch", **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.policy = make_policy(policy_name, **(policy_kwargs or {}))
+        self.by = by
+        self.loader = None
+        self.gds: list = []
+        self.fused = None
+        self._iteration = 0
+        self._base_rates: Optional[list] = None
+
+    def run(self) -> None:
+        from veles_tpu.loader.base import TRAIN
+        if self._base_rates is None:
+            self._base_rates = [(gd.learning_rate, gd.learning_rate_bias)
+                                for gd in self.gds]
+        if self.loader is not None and \
+                self.loader.minibatch_class != TRAIN:
+            return
+        t = self.loader.epoch_number if self.by == "epoch" \
+            else self._iteration
+        self._iteration += 1
+        scales = []
+        for gd, (base_w, base_b) in zip(self.gds, self._base_rates):
+            lr = self.policy(base_w, t)
+            gd.learning_rate = lr
+            gd.learning_rate_bias = self.policy(base_b, t)
+            scales.append(lr / base_w if base_w else 1.0)
+        if self.fused is not None:
+            self.fused.lr_scales = scales

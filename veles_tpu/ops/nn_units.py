@@ -77,6 +77,12 @@ class ForwardUnit(AcceleratedUnit):
         """{} when the unit has no parameters."""
         return {}
 
+    def weight_fan_in(self, shape: Tuple[int, ...]) -> int:
+        """Inputs contributing to one output element (default: all axes
+        but the last are input-side; Deconv overrides — its out-channel
+        axis is not last)."""
+        return int(np.prod(shape[:-1]))
+
     def fill_params(self, input_shape: Tuple[int, ...]) -> None:
         """Deterministic init through the 'weights' PRNG stream — both
         backends see identical initial parameters."""
@@ -90,8 +96,7 @@ class ForwardUnit(AcceleratedUnit):
             stddev = self.weights_stddev if pname == "weights" \
                 else self.bias_stddev
             if stddev is None:
-                fan_in = int(np.prod(shape[:-1])) or 1
-                stddev = 1.0 / np.sqrt(fan_in)
+                stddev = 1.0 / np.sqrt(self.weight_fan_in(shape) or 1)
             if filling == "uniform":
                 arr = gen.uniform(-stddev * np.sqrt(3), stddev * np.sqrt(3),
                                   shape)

@@ -38,6 +38,7 @@ class StandardWorkflow(NNWorkflow):
                  loss_function: str = "softmax",
                  decision_config: Optional[Dict[str, Any]] = None,
                  snapshotter_config: Optional[Dict[str, Any]] = None,
+                 lr_adjust_config: Optional[Dict[str, Any]] = None,
                  **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.loss_function = loss_function
@@ -60,6 +61,14 @@ class StandardWorkflow(NNWorkflow):
         self.fused = FusedStepRunner(
             self, loader=self.loader, forwards=self.forwards,
             evaluator=self.evaluator, gds=self.gds, name="fused_step")
+        self.lr_adjust = None
+        if lr_adjust_config:
+            from veles_tpu.ops.lr_adjust import LearningRateAdjust
+            self.lr_adjust = LearningRateAdjust(
+                self, name="lr_adjust", **lr_adjust_config)
+            self.lr_adjust.loader = self.loader
+            self.lr_adjust.gds = self.gds
+            self.lr_adjust.fused = self.fused
         self._extra_after_decision: list = []
 
     # -- unit creation -------------------------------------------------
@@ -108,9 +117,6 @@ class StandardWorkflow(NNWorkflow):
             gd_kwargs = dict(cfg.get("<-", {}))
             gd = gd_cls(self, forward=fwd, name=f"gd{i}_{kind}",
                         **gd_kwargs)
-            # never train on validation/test minibatches
-            gd.gate_skip = Bool.from_expr(
-                lambda ld=loader: ld.minibatch_class != TRAIN)
             self.gds.append(gd)
 
     def _create_decision(self, cfg: Dict[str, Any]) -> None:
@@ -132,15 +138,26 @@ class StandardWorkflow(NNWorkflow):
         for u in self.units:
             u.links_from.clear()
             u.links_to.clear()
+        # Derived Bool gates hold closures, which pickling flattens to
+        # their momentary values (mutable.Bool.__getstate__) — every
+        # expression gate must therefore be re-established at wiring
+        # time, or a resumed run trains on validation minibatches.
+        loader = self.loader
+        for gd in self.gds:
+            gd.gate_skip = Bool.from_expr(
+                lambda ld=loader: ld.minibatch_class != TRAIN)
 
     def _wire_common_tail(self, before_decision) -> None:
         self.decision.link_from(before_decision)
         tail = self.decision
         if self.snapshotter is not None:
+            # fire on the validation-improved firing (weights at that
+            # moment are the end-of-previous-train-epoch weights the
+            # improvement was measured with; reference: Decision
+            # triggers Snapshotter on improvement)
             self.snapshotter.link_from(self.decision)
             self.snapshotter.gate_skip = Bool.from_expr(
-                lambda d=self.decision: not (bool(d.epoch_ended_flag)
-                                             and bool(d.improved)))
+                lambda d=self.decision: not bool(d.improved))
             tail = self.snapshotter
         for extra in self._extra_after_decision:
             extra.link_from(tail)
@@ -157,6 +174,9 @@ class StandardWorkflow(NNWorkflow):
         self.repeater.link_from(self.start_point)
         self.loader.link_from(self.repeater)
         prev = self.loader
+        if self.lr_adjust is not None:
+            self.lr_adjust.link_from(prev)
+            prev = self.lr_adjust
         for f in self.forwards:
             f.link_from(prev)
             prev = f
@@ -181,7 +201,11 @@ class StandardWorkflow(NNWorkflow):
         self.loader.host_fill_enabled = False
         self.repeater.link_from(self.start_point)
         self.loader.link_from(self.repeater)
-        self.fused.link_from(self.loader)
+        prev = self.loader
+        if self.lr_adjust is not None:
+            self.lr_adjust.link_from(prev)
+            prev = self.lr_adjust
+        self.fused.link_from(prev)
         self._wire_common_tail(self.fused)
 
     # -- lifecycle -----------------------------------------------------

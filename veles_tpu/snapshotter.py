@@ -1,0 +1,109 @@
+"""Whole-workflow snapshot / resume.
+
+Reference parity: veles/snapshotter.py — pickles the entire workflow
+object graph ("snapshots", gz/bz2/xz), triggered by Decision on
+validation improvement and/or every N epochs; ``--snapshot file``
+resumes a run exactly where it stopped (SURVEY.md §4.4).
+
+TPU adaptation: only host state is pickled — ``Vector.__getstate__``
+syncs device->host first, units drop device handles and compiled
+executables (Unit._unpicklable), and the fused runner folds its donated
+param/optimizer pytrees back into Vectors.  Resume re-attaches a device
+and re-jits.  PRNG stream states ride along so stochastic ops continue
+their exact sequences.
+"""
+
+from __future__ import annotations
+
+import bz2
+import gzip
+import lzma
+import os
+import pickle
+import time
+from typing import Any, Optional
+
+from veles_tpu import prng
+from veles_tpu.units import Unit
+
+_OPENERS = {".gz": gzip.open, ".bz2": bz2.open, ".xz": lzma.open,
+            "": open}
+
+
+def _opener(path: str):
+    for suffix, op in _OPENERS.items():
+        if suffix and path.endswith(suffix):
+            return op
+    return open
+
+
+def save_workflow(workflow, path: str) -> str:
+    """Pickle (workflow, prng state) to ``path`` (compression by
+    suffix: .gz/.bz2/.xz)."""
+    payload = {
+        "format": 1,
+        "workflow": workflow,
+        "prng": prng.snapshot_state(),
+        "timestamp": time.time(),
+    }
+    tmp = path + ".tmp"
+    with _opener(path)(tmp, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+    return path
+
+
+def load_workflow(path: str):
+    """Restore a workflow; caller must .initialize(device=...) before
+    .run() (re-attaches devices, re-jits, reloads non-pickled data)."""
+    with _opener(path)(path, "rb") as f:
+        payload = pickle.load(f)
+    prng.restore_state(payload["prng"])
+    return payload["workflow"]
+
+
+class Snapshotter(Unit):
+    """Graph node: fires after Decision; writes a snapshot when gated
+    open (StandardWorkflow gates it on epoch-end & improvement)."""
+
+    def __init__(self, workflow=None, prefix: str = "snapshot",
+                 directory: Optional[str] = None,
+                 compression: str = "gz",
+                 interval: int = 1, keep: int = 3,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.prefix = prefix
+        self.directory = directory or os.path.join(
+            os.path.expanduser("~"), ".veles_tpu", "snapshots")
+        self.compression = compression.lstrip(".")
+        self.interval = interval
+        self.keep = keep
+        self.decision = None
+        self.last_path: Optional[str] = None
+        self._epoch_count = 0
+        self._written: list = []
+
+    def run(self) -> None:
+        self._epoch_count += 1
+        if self.interval > 1 and self._epoch_count % self.interval:
+            return
+        os.makedirs(self.directory, exist_ok=True)
+        err = ""
+        if self.decision is not None:
+            err = f"_{self.decision.epoch_error_pct[1]:.2f}pt"
+        epoch = getattr(getattr(self.workflow, "loader", None),
+                        "epoch_number", self._epoch_count)
+        suffix = f".{self.compression}" if self.compression else ""
+        path = os.path.join(
+            self.directory,
+            f"{self.prefix}_epoch{epoch}{err}.pickle{suffix}")
+        save_workflow(self.workflow, path)
+        self.last_path = path
+        self.info("snapshot -> %s", path)
+        self._written.append(path)
+        while len(self._written) > self.keep:
+            old = self._written.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
