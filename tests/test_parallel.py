@@ -1,0 +1,150 @@
+"""SPMD data parallelism (veles_tpu/parallel/): the sharded fused step
+on an 8-device virtual CPU mesh must reproduce the single-device
+training trajectory — the allreduce-in-compiler replacement for the
+reference's master--slave aggregation (SURVEY.md §3.4, §5.8)."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import JaxDevice
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+from veles_tpu.parallel import (DataParallel, MeshJaxDevice, batch_sharding,
+                                make_mesh, replicated_sharding)
+
+
+def build_workflow(mb=48, max_epochs=2, momentum=0.9):
+    prng.seed_all(777)
+    train, valid, _ = synthetic_classification(
+        480, 192, (12, 12, 1), n_classes=10, seed=42)
+    gd = {"learning_rate": 0.1, "weight_decay": 0.0001,
+          "gradient_moment": momentum}
+    return StandardWorkflow(
+        loader_factory=lambda w: ArrayLoader(
+            w, train=train, valid=valid, minibatch_size=mb, name="loader"),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 32},
+             "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": max_epochs},
+        name="dp_test")
+
+
+def valid_history(w):
+    return [h for h in w.decision.history if h["class"] == "validation"]
+
+
+class TestMesh:
+    def test_make_mesh(self):
+        mesh = make_mesh(8)
+        assert mesh.devices.size == 8
+        assert mesh.axis_names == ("data",)
+
+    def test_make_mesh_too_many(self):
+        with pytest.raises(ValueError, match="need 64 devices"):
+            make_mesh(64)
+
+    def test_shardings(self):
+        import jax
+        mesh = make_mesh(4)
+        x = jax.device_put(np.zeros((8, 3), np.float32),
+                           batch_sharding(mesh))
+        assert not x.is_fully_replicated
+        r = jax.device_put(np.zeros((8, 3), np.float32),
+                           replicated_sharding(mesh))
+        assert r.is_fully_replicated
+
+
+class TestDataParallel:
+    def test_install_rejects_indivisible(self):
+        w = build_workflow(mb=50)
+        dp = DataParallel(w, 8)
+        with pytest.raises(ValueError, match="not divisible"):
+            dp.install()
+
+    def test_rejects_clamped_static_batch(self):
+        """minibatch_size divisible but every class smaller: the static
+        shape clamps to max_minibatch_size — must fail with a clear
+        error at initialize, not crash inside device_put."""
+        prng.seed_all(777)
+        train, valid, _ = synthetic_classification(
+            100, 40, (8, 8, 1), n_classes=4, seed=1)
+        w = StandardWorkflow(
+            loader_factory=lambda wf: ArrayLoader(
+                wf, train=train, valid=valid, minibatch_size=128,
+                name="loader"),
+            layers=[{"type": "softmax", "->": {"output_sample_shape": 4},
+                     "<-": {"learning_rate": 0.1}}],
+            decision_config={"max_epochs": 1}, name="clamped")
+        dp = DataParallel(w, 8)
+        dev = dp.install()   # passes: 128 % 8 == 0
+        with pytest.raises(ValueError, match="max_minibatch_size"):
+            w.initialize(device=dev)
+
+    def test_mesh_device_put_replicates(self):
+        dev = MeshJaxDevice(make_mesh(8))
+        buf = dev.put(np.arange(16, dtype=np.float32))
+        assert buf.is_fully_replicated
+        np.testing.assert_array_equal(np.asarray(buf), np.arange(16))
+
+    def test_dp_matches_single_device(self):
+        """The sharded global-minibatch step must follow the same
+        trajectory as the unsharded fused step (same seed)."""
+        w1 = build_workflow()
+        w1.initialize(device=JaxDevice(platform="cpu"))
+        w1.run()
+
+        w8 = build_workflow()
+        dp = DataParallel(w8, 8)
+        w8.initialize(device=dp.install())
+        w8.run()
+
+        h1, h8 = valid_history(w1), valid_history(w8)
+        assert len(h1) == len(h8) == 2
+        for a, b in zip(h1, h8):
+            assert abs(a["loss"] - b["loss"]) < 5e-3, (a, b)
+            assert abs(a["n_err"] - b["n_err"]) <= 3, (a, b)
+
+    def test_dp_learns_and_params_replicated(self):
+        w = build_workflow(max_epochs=8)
+        dp = DataParallel(w, 8)
+        w.initialize(device=dp.install())
+        w.run()
+        assert w.decision.epoch_error_pct[1] < 40.0, \
+            w.decision.epoch_error_pct
+        # updated weights must still be replicated across the mesh
+        # (anything else means the partitioner failed to allreduce)
+        wts = w.fused._params[w.forwards[0].name]["weights"]
+        assert wts.is_fully_replicated
+        assert np.isfinite(np.asarray(wts)).all()
+
+    def test_dp_snapshot_roundtrip(self, tmp_path):
+        """Mesh never reaches the pickle; resumed run re-installs DP."""
+        import pickle
+        w = build_workflow(max_epochs=1)
+        dp = DataParallel(w, 4)
+        w.initialize(device=dp.install())
+        w.run()
+        blob = pickle.dumps(w)
+        w2 = pickle.loads(blob)
+        assert w2.fused.mesh is None
+        dp2 = DataParallel(w2, 4)
+        w2.decision.max_epochs = 2
+        w2.initialize(device=dp2.install())
+        w2.run()
+        assert len(valid_history(w2)) >= 1
+
+
+class TestLauncherDP:
+    def test_launcher_dp_flag(self):
+        from veles_tpu.launcher import Launcher
+        launcher = Launcher(backend="cpu", seed=777, dp=8)
+        launcher.create_workflow(lambda l: build_workflow(max_epochs=1))
+        launcher.initialize()
+        assert isinstance(launcher.device, MeshJaxDevice)
+        launcher.run()
+        assert len(valid_history(launcher.workflow)) == 1

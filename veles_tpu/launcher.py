@@ -74,8 +74,19 @@ class Launcher(Logger):
     def initialize(self, **kwargs: Any) -> None:
         if self.dp and self.dp > 1:
             from veles_tpu.parallel import DataParallel
-            self.workflow_dp = DataParallel(self.workflow, self.dp)
-            self.workflow_dp.install()
+            if not self.device.is_jax:
+                raise ValueError("--dp requires a jax backend "
+                                 "(tpu/jax/cpu), not numpy")
+            # mesh over the devices of the SELECTED backend platform —
+            # jax.devices() alone would pick the default platform even
+            # when the user asked for -b cpu
+            import jax
+            devices = jax.devices(self.device.platform)
+            self.workflow_dp = DataParallel(self.workflow, self.dp,
+                                            devices=devices)
+            # the mesh device replaces the single-chip device: Vectors
+            # upload replicated, the fused step jits sharded
+            self.device = self.workflow_dp.install()
         self.workflow.initialize(device=self.device, **kwargs)
 
     def run(self) -> None:

@@ -47,6 +47,10 @@ class FusedStepRunner(AcceleratedUnit):
         #: for frozen/param-less layers that still need err routing)
         self.gds: List[Any] = gds or []
         self.rng_stream = rng_stream
+        #: a jax.sharding.Mesh when DataParallel is installed — the
+        #: steps are then jitted with the minibatch sharded over the
+        #: mesh's data axis and params replicated (parallel/ package)
+        self.mesh = None
         self._train_step = None
         self._eval_step = None
         self._params: Optional[Dict[str, Dict[str, Any]]] = None
@@ -58,7 +62,8 @@ class FusedStepRunner(AcceleratedUnit):
         self.lr_scales = [1.0] * len(self.gds)
 
     _unpicklable = AcceleratedUnit._unpicklable + (
-        "_train_step", "_eval_step", "_params", "_opt")
+        "_train_step", "_eval_step", "_params", "_opt", "mesh",
+        "_batch_sharding")
 
     # -- pytree assembly ----------------------------------------------
 
@@ -164,13 +169,43 @@ class FusedStepRunner(AcceleratedUnit):
             m.pop("err_output")
             return m, out
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
-        self._eval_step = jax.jit(eval_step)
+        if self.mesh is not None:
+            # SPMD data parallelism: minibatch rows sharded over the
+            # data axis, params/dataset replicated.  mask.sum() and the
+            # per-param batch reductions cross the sharded axis, so the
+            # partitioner emits the gradient allreduce (ICI psum) —
+            # this IS the master-slave aggregation, in-compiler.
+            from veles_tpu.parallel.mesh import (batch_sharding,
+                                                 replicated_sharding)
+            repl = replicated_sharding(self.mesh)
+            batch = self._batch_sharding = batch_sharding(self.mesh)
+            self._train_step = jax.jit(
+                train_step, donate_argnums=(0, 1),
+                in_shardings=(repl, repl, repl, repl, batch, batch,
+                              repl, repl))
+            self._eval_step = jax.jit(
+                eval_step,
+                in_shardings=(repl, repl, repl, batch, batch, repl))
+        else:
+            self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
+            self._eval_step = jax.jit(eval_step)
 
     # -- lifecycle -----------------------------------------------------
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
+        if self.mesh is not None:
+            # the STATIC minibatch shape is max_minibatch_size, which
+            # clamps below minibatch_size when every class is smaller —
+            # DataParallel.install() can only check minibatch_size
+            # (load_data hasn't run yet there)
+            n = int(self.mesh.devices.size)
+            mb = self.loader.max_minibatch_size
+            if mb % n:
+                raise ValueError(
+                    f"static minibatch shape {mb} (loader "
+                    f"max_minibatch_size) not divisible by mesh size "
+                    f"{n}; lower minibatch_size or pad the dataset")
         if self._train_step is None:
             self._build_steps()
 
@@ -190,6 +225,13 @@ class FusedStepRunner(AcceleratedUnit):
         mask = ld.minibatch_mask.unmap()
         dataset = ld.original_data.unmap()
         targets = self._target_store()
+        if self.mesh is not None:
+            # Vectors upload replicated (MeshJaxDevice.put); the batch
+            # args must enter the step sharded over the data axis —
+            # replicated->sharded is a local slice, no communication.
+            import jax
+            indices = jax.device_put(indices, self._batch_sharding)
+            mask = jax.device_put(mask, self._batch_sharding)
         if ld.minibatch_class == TRAIN:
             self._params, self._opt, m = self._train_step(
                 self._params, self._opt, dataset, targets, indices, mask,

@@ -1,0 +1,23 @@
+"""Distributed execution: SPMD data parallelism over a device mesh.
+
+Reference parity: the reference's ONLY parallelism strategy is
+master--slave data parallelism with centralized gradient aggregation
+over ZeroMQ (veles/server.py, veles/client.py, SURVEY.md §3.4).  The
+TPU-native replacement (the BASELINE.json north star, verbatim) is an
+**ICI allreduce**: the fused training step is jitted over a
+``jax.sharding.Mesh`` with the minibatch sharded along a ``data`` axis
+and parameters replicated; XLA's SPMD partitioner inserts the gradient
+``psum`` automatically because the batch reduction crosses the sharded
+axis.  Multi-host runs extend the same mesh over DCN via
+``jax.distributed.initialize`` (Launcher ``--multihost``).
+
+The zmq master--slave protocol survives as a DCN-only compat path for
+heterogeneous clusters (veles_tpu/server.py, veles_tpu/client.py).
+"""
+
+from veles_tpu.parallel.mesh import (batch_sharding, make_mesh,
+                                     replicated_sharding)
+from veles_tpu.parallel.data_parallel import DataParallel, MeshJaxDevice
+
+__all__ = ["make_mesh", "batch_sharding", "replicated_sharding",
+           "DataParallel", "MeshJaxDevice"]

@@ -1,0 +1,94 @@
+"""DataParallel: install SPMD data parallelism on a StandardWorkflow.
+
+Replaces the reference's master--slave gradient aggregation
+(veles/server.py: apply_data_from_slave summing weight diffs into
+canonical weights) with a single-controller sharded jit: the fused
+step's minibatch ``indices``/``mask`` are sharded over the mesh's
+``data`` axis, parameters stay replicated, and XLA inserts the gradient
+allreduce over ICI.  Semantics are synchronous SGD on the GLOBAL
+minibatch — numerically the same training trajectory as the
+single-device fused step (the tests assert this on a virtual CPU mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from veles_tpu.backends import JaxDevice
+from veles_tpu.logger import Logger
+from veles_tpu.parallel.mesh import make_mesh, replicated_sharding
+
+
+class MeshJaxDevice(JaxDevice):
+    """A JaxDevice whose buffers live replicated across a mesh.
+
+    ``put`` uploads host arrays with a fully-replicated NamedSharding so
+    Vectors initialized through the normal ``Vector.initialize(device)``
+    path are immediately consumable by the sharded step without a
+    resharding transfer.
+    """
+
+    backend_name = "mesh"
+
+    def __init__(self, mesh, compute_dtype: Any = None) -> None:
+        import jax
+
+        self.mesh = mesh
+        self._repl = replicated_sharding(mesh)
+        platform = mesh.devices.flat[0].platform
+        super().__init__(platform=platform, compute_dtype=compute_dtype)
+        self._jax = jax
+
+    def put(self, array) -> Any:
+        import numpy as np
+        return self._jax.device_put(np.array(array, copy=True), self._repl)
+
+    def __repr__(self) -> str:
+        n = self.mesh.devices.size
+        return f"<MeshJaxDevice {n}x{self.platform} axes={self.mesh.axis_names}>"
+
+
+class DataParallel(Logger):
+    """Wires a mesh into a StandardWorkflow's fused step.
+
+    Usage (what Launcher does for ``--dp=N``)::
+
+        dp = DataParallel(workflow, n)
+        device = dp.install()          # BEFORE workflow.initialize
+        workflow.initialize(device=device)
+
+    After ``install()`` the workflow's ``FusedStepRunner`` jits its
+    train/eval steps with mesh shardings; everything else (Decision,
+    Snapshotter, plotters) is unchanged — they observe replicated
+    Vectors exactly as in the single-device run.
+    """
+
+    def __init__(self, workflow, dp: Optional[int] = None,
+                 axis_name: str = "data", mesh=None, devices=None,
+                 compute_dtype: Any = None) -> None:
+        self.workflow = workflow
+        self.mesh = mesh if mesh is not None \
+            else make_mesh(dp, axis_name, devices=devices)
+        self.device = MeshJaxDevice(self.mesh, compute_dtype=compute_dtype)
+
+    @property
+    def num_devices(self) -> int:
+        return int(self.mesh.devices.size)
+
+    def install(self) -> MeshJaxDevice:
+        fused = getattr(self.workflow, "fused", None)
+        if fused is None:
+            raise ValueError(
+                "DataParallel needs a StandardWorkflow with a fused step "
+                "(the numpy/eager path has no sharded execution)")
+        n = self.num_devices
+        loader = self.workflow.loader
+        mb = loader.minibatch_size
+        if mb % n:
+            raise ValueError(
+                f"minibatch_size {mb} not divisible by mesh size {n}")
+        fused.mesh = self.mesh
+        self.info("data parallel over %d devices (%s), global minibatch "
+                  "%d -> %d per device", n, self.mesh.axis_names[0], mb,
+                  mb // n)
+        return self.device

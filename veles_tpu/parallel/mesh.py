@@ -1,0 +1,48 @@
+"""Mesh construction and sharding helpers.
+
+One flat ``data`` axis covers the reference's capability surface (pure
+data parallelism, SURVEY.md §3.4 — no tensor/pipeline parallelism to
+reproduce).  Helpers return ``NamedSharding``s so call sites never
+touch PartitionSpec spelling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def make_mesh(n: Optional[int] = None, axis_name: str = "data",
+              devices: Optional[Sequence] = None):
+    """A 1-D mesh over the first ``n`` visible devices (all by default).
+
+    On a multi-host run ``jax.devices()`` already enumerates every chip
+    in the slice, so the same call builds the global mesh.
+    """
+    import jax
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n is None:
+        n = len(devs)
+    if len(devs) < n:
+        raise ValueError(
+            f"need {n} devices for the mesh, only {len(devs)} visible "
+            f"(tests simulate with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    return jax.sharding.Mesh(np.array(devs[:n]), (axis_name,))
+
+
+def replicated_sharding(mesh):
+    """Every device holds the full array (params, dataset, scalars)."""
+    import jax
+
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
+def batch_sharding(mesh):
+    """Leading axis split across the data axis (minibatch rows)."""
+    import jax
+
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(mesh.axis_names[0]))
