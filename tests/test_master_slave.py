@@ -1,0 +1,201 @@
+"""zmq master--slave DCN compat mode (veles_tpu/server.py, client.py):
+reference-parity data parallelism with centralized aggregation
+(SURVEY.md §4.2).  Master and slaves run in threads over localhost."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import JaxDevice, NumpyDevice
+from veles_tpu.client import SlaveClient
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+from veles_tpu.server import MasterServer
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def build_workflow(max_epochs=2, momentum=0.9):
+    prng.seed_all(777)
+    train, valid, _ = synthetic_classification(
+        300, 120, (10, 10, 1), n_classes=5, seed=42)
+    gd = {"learning_rate": 0.1, "weight_decay": 0.0001,
+          "gradient_moment": momentum}
+    return StandardWorkflow(
+        loader_factory=lambda w: ArrayLoader(
+            w, train=train, valid=valid, minibatch_size=30, name="loader"),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 24},
+             "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 5},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": max_epochs},
+        name="ms_test")
+
+
+def run_cluster(n_slaves, max_epochs=2, momentum=0.9):
+    addr = f"tcp://127.0.0.1:{free_port()}"
+    master_w = build_workflow(max_epochs, momentum)
+    master_w.initialize(device=NumpyDevice())
+    slave_ws = []
+    for _ in range(n_slaves):
+        w = build_workflow(max_epochs, momentum)
+        w.initialize(device=JaxDevice(platform="cpu"))
+        slave_ws.append(w)
+
+    server = MasterServer(master_w, addr, job_timeout=30.0, linger_s=0.5)
+    clients = [SlaveClient(w, addr, timeout_ms=30000) for w in slave_ws]
+    threads = [threading.Thread(target=c.serve, daemon=True)
+               for c in clients]
+    mt = threading.Thread(target=server.serve, daemon=True)
+    mt.start()
+    for t in threads:
+        t.start()
+    mt.join(timeout=120)
+    assert not mt.is_alive(), "master did not finish"
+    for t in threads:
+        t.join(timeout=30)
+    return master_w, clients
+
+
+def valid_history(w):
+    return [h for h in w.decision.history if h["class"] == "validation"]
+
+
+class TestMasterSlave:
+    def test_single_slave_matches_standalone(self):
+        """One slave + in-order application == the standalone fused
+        trajectory (fp32 add-roundtrip tolerance only)."""
+        w_ref = build_workflow()
+        w_ref.initialize(device=JaxDevice(platform="cpu"))
+        w_ref.run()
+
+        master_w, clients = run_cluster(1)
+        assert clients[0].jobs_done > 0
+        h_ref, h_ms = valid_history(w_ref), valid_history(master_w)
+        assert len(h_ref) == len(h_ms) == 2
+        for a, b in zip(h_ref, h_ms):
+            assert abs(a["loss"] - b["loss"]) < 1e-4, (a, b)
+            assert abs(a["n_err"] - b["n_err"]) <= 1, (a, b)
+        # canonical master weights track the slave's updates
+        w_fin = master_w.forwards[0].weights.map_read()
+        r_fin = np.asarray(w_ref.fused._params[
+            w_ref.forwards[0].name]["weights"])
+        np.testing.assert_allclose(w_fin, r_fin, atol=1e-4)
+
+    def test_three_slaves_train(self):
+        """Async DP with 3 slaves: protocol terminates at max_epochs,
+        spreads work, and the loss decreases (bounded-staleness SGD is
+        NOISIER than sync — don't expect the standalone trajectory)."""
+        master_w, clients = run_cluster(3, max_epochs=8, momentum=0.0)
+        assert bool(master_w.decision.complete)
+        # the issue-ahead window must stop any one slave racing ahead:
+        # every slave gets a meaningful share of the ~112 jobs
+        assert all(c.jobs_done >= 10 for c in clients), \
+            [c.jobs_done for c in clients]
+        hist = [h for h in master_w.decision.history
+                if h["class"] == "train"]
+        assert hist[0]["epoch"] == 1 and hist[-1]["epoch"] == 8
+        assert hist[-1]["loss"] < hist[0]["loss"] - 0.2, \
+            [(h["epoch"], h["loss"]) for h in hist]
+        assert np.isfinite(master_w.forwards[0].weights.map_read()).all()
+
+    def test_zombie_slave_job_requeued_and_master_terminates(self):
+        """Elasticity + liveness: a slave that takes a job and vanishes
+        must not wedge the in-order application head (job requeued after
+        job_timeout) nor prevent termination at complete."""
+        import pickle
+        import zmq
+
+        addr = f"tcp://127.0.0.1:{free_port()}"
+        master_w = build_workflow(max_epochs=2, momentum=0.0)
+        master_w.initialize(device=NumpyDevice())
+        sw = build_workflow(max_epochs=2, momentum=0.0)
+        sw.initialize(device=JaxDevice(platform="cpu"))
+
+        server = MasterServer(master_w, addr, job_timeout=1.5,
+                              linger_s=0.5)
+        mt = threading.Thread(target=server.serve, daemon=True)
+        mt.start()
+
+        # zombie: handshake, grab the FIRST job, never report back
+        ctx = zmq.Context.instance()
+        zombie = ctx.socket(zmq.REQ)
+        zombie.setsockopt(zmq.RCVTIMEO, 10000)
+        zombie.setsockopt(zmq.LINGER, 0)
+        zombie.connect(addr)
+        zombie.send(pickle.dumps({"type": "handshake", "id": "zombie"}))
+        pickle.loads(zombie.recv())
+        zombie.send(pickle.dumps({"type": "job_request"}))
+        job = pickle.loads(zombie.recv())
+        assert job["type"] == "job" and job["seq"] == 0
+        zombie.close(0)
+
+        c1 = SlaveClient(sw, addr, timeout_ms=30000)
+        t1 = threading.Thread(target=c1.serve, daemon=True)
+        t1.start()
+        mt.join(timeout=90)
+        assert not mt.is_alive(), "master wedged by zombie slave"
+        t1.join(timeout=30)
+        assert bool(master_w.decision.complete)
+        # job 0 was reissued to the live slave and applied
+        assert server._applied >= 28  # 2 epochs x 14 minibatches
+
+    def test_late_joining_slave_gets_current_weights(self):
+        """Elasticity: a slave that connects mid-run receives canonical
+        weights in its handshake, not initial ones."""
+        addr = f"tcp://127.0.0.1:{free_port()}"
+        master_w = build_workflow(max_epochs=10)
+        master_w.initialize(device=NumpyDevice())
+        w0 = np.array(master_w.forwards[0].weights.map_read())
+
+        # build BOTH slave workflows up front so the late join below is
+        # instant (no jit warm-up racing the master's finish)
+        first = build_workflow(max_epochs=10)
+        first.initialize(device=JaxDevice(platform="cpu"))
+        second = build_workflow(max_epochs=10)
+        second.initialize(device=JaxDevice(platform="cpu"))
+
+        server = MasterServer(master_w, addr, linger_s=0.5)
+        mt = threading.Thread(target=server.serve, daemon=True)
+        mt.start()
+
+        c1 = SlaveClient(first, addr, timeout_ms=30000)
+        t1 = threading.Thread(target=c1.serve, daemon=True)
+        t1.start()
+
+        # wait until some jobs are applied, then join a second slave
+        import time
+        deadline = time.monotonic() + 60
+        while server._applied < 5 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server._applied >= 5
+
+        c2 = SlaveClient(second, addr, timeout_ms=30000)
+        got = {}
+        orig = c2._rpc
+
+        def spy(sock, msg):
+            reply = orig(sock, msg)
+            if msg.get("type") == "handshake":
+                got["params"] = reply["params"]
+            return reply
+
+        c2._rpc = spy
+        t2 = threading.Thread(target=c2.serve, daemon=True)
+        t2.start()
+        mt.join(timeout=120)
+        assert not mt.is_alive()
+        t1.join(timeout=30)
+        t2.join(timeout=30)
+        hs = got["params"][master_w.forwards[0].name]["weights"]
+        assert not np.allclose(hs, w0), "handshake sent initial weights"

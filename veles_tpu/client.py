@@ -1,0 +1,94 @@
+"""Slave client: zmq master--slave data parallelism (DCN compat mode).
+
+Reference parity: veles/client.py — connect, handshake, pull a job,
+apply master data, run ONE iteration on the local device, send the
+update back (SURVEY.md §4.2).  The iteration here is the fused jitted
+step, so a "slave" is a full single-chip TPU (or CPU) worker; only the
+weight diffs and scalar metrics cross the network.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+import uuid
+from typing import Any, Dict
+
+import numpy as np
+
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.logger import Logger
+
+
+def _tree_sub(a: Dict[str, Dict[str, np.ndarray]],
+              b: Dict[str, Dict[str, np.ndarray]]):
+    return {fn: {pn: np.asarray(a[fn][pn]) - np.asarray(b[fn][pn])
+                 for pn in a[fn]} for fn in a}
+
+
+class SlaveClient(Logger):
+    def __init__(self, workflow, master_address: str,
+                 timeout_ms: int = 120000) -> None:
+        self.workflow = workflow
+        self.master_address = master_address
+        self.timeout_ms = timeout_ms
+        self.slave_id = uuid.uuid4().hex[:8]
+        self.jobs_done = 0
+
+    # -- one iteration -------------------------------------------------
+
+    def _run_job(self, job: dict) -> dict:
+        w = self.workflow
+        loader, fused, ev = w.loader, w.fused, w.evaluator
+        loader.apply_data_from_master(job["loader"])
+        fused.set_host_params(job["params"])
+        if job.get("lr_scales"):
+            fused.lr_scales = list(job["lr_scales"])
+        fused.run()
+        metrics = {"n_err": float(np.asarray(ev.n_err.current()).sum()),
+                   "loss_sum": float(np.asarray(ev.loss.current()).sum()),
+                   "count": float(np.asarray(ev.count.current()).sum())}
+        diff = None
+        if loader.minibatch_class == TRAIN:
+            diff = _tree_sub(fused.host_params(), job["params"])
+        return {"type": "job_done", "seq": job["seq"],
+                "params_diff": diff, "metrics": metrics}
+
+    # -- serve loop ----------------------------------------------------
+
+    def serve(self) -> None:
+        import zmq
+
+        # the fused path gathers rows on-device from the local dataset
+        self.workflow.loader.host_fill_enabled = False
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.REQ)
+        sock.setsockopt(zmq.RCVTIMEO, self.timeout_ms)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(self.master_address)
+        self.info("slave %s connecting to %s", self.slave_id,
+                  self.master_address)
+        try:
+            reply = self._rpc(sock, {"type": "handshake",
+                                     "id": self.slave_id})
+            self.workflow.fused.set_host_params(reply["params"])
+            while True:
+                reply = self._rpc(sock, {"type": "job_request"})
+                if reply["type"] == "bye":
+                    break
+                if reply["type"] == "wait":
+                    time.sleep(reply.get("delay_ms", 20) / 1000.0)
+                    continue
+                if reply["type"] != "job":
+                    raise RuntimeError(f"unexpected reply {reply!r}")
+                result = self._rpc(sock, self._run_job(reply))
+                if result["type"] != "ack":
+                    raise RuntimeError(f"unexpected ack {result!r}")
+                self.jobs_done += 1
+        finally:
+            sock.close(0)
+        self.info("slave %s done: %d jobs", self.slave_id, self.jobs_done)
+
+    def _rpc(self, sock, msg: dict) -> dict:
+        sock.send(pickle.dumps(msg, protocol=4))
+        return pickle.loads(sock.recv())

@@ -133,6 +133,13 @@ class Vector:
         self._valid = HOST
         return self._mem
 
+    def current(self) -> Any:
+        """Freshest buffer without forcing a transfer: the device array
+        when one is bound (possibly an un-fetched step output), else the
+        host array.  Callers that need numpy use ``np.asarray`` on the
+        result (that is the sync point)."""
+        return self._devmem if self._devmem is not None else self._mem
+
     def unmap(self) -> Any:
         """Device is about to compute: push host->device if device stale.
         Returns the device buffer (or host mem on numpy devices)."""

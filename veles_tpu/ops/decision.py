@@ -86,13 +86,8 @@ class DecisionGD(Unit, Distributable):
         self.epoch_ended_flag.set(False)
         ev = self.evaluator
         if ev is not None:
-            nerr = ev.n_err.devmem if ev.n_err.devmem is not None \
-                else ev.n_err.mem
-            loss = ev.loss.devmem if ev.loss.devmem is not None \
-                else ev.loss.mem
-            count = ev.count.devmem if ev.count.devmem is not None \
-                else ev.count.mem
-            self.accumulate(nerr, loss, count)
+            self.accumulate(ev.n_err.current(), ev.loss.current(),
+                            ev.count.current())
         ld = self.loader
         if bool(ld.class_ended):
             klass = ld.minibatch_class

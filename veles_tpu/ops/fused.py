@@ -218,9 +218,7 @@ class FusedStepRunner(AcceleratedUnit):
     def run(self) -> None:
         ld = self.loader
         ev = self.evaluator
-        if self._params is None:
-            self._params = self._collect_params()
-            self._opt = self._collect_opt()
+        self._ensure_params()
         indices = ld.minibatch_indices.unmap()
         mask = ld.minibatch_mask.unmap()
         dataset = ld.original_data.unmap()
@@ -258,6 +256,29 @@ class FusedStepRunner(AcceleratedUnit):
                 for h in self._conf_handles:
                     ev.confusion.mem += np.asarray(h)
                 self._conf_handles.clear()
+
+    # -- zmq DCN compat mode (server.py / client.py) -------------------
+
+    def _ensure_params(self) -> None:
+        if self._params is None:
+            self._params = self._collect_params()
+            self._opt = self._collect_opt()
+
+    def host_params(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """Current parameters as host numpy arrays (slave -> diff)."""
+        self._ensure_params()
+        return {fn: {pn: np.asarray(v) for pn, v in d.items()}
+                for fn, d in self._params.items()}
+
+    def set_host_params(self, params) -> None:
+        """Adopt master-provided parameters (device upload; velocities
+        stay local, as in the reference's slave)."""
+        self._ensure_params()
+        self._params = {
+            fn: {pn: self.device.put(np.asarray(params[fn][pn]))
+                 for pn in d}
+            for fn, d in self._params.items()}
+        self._scatter_params(self._params, self._opt or {})
 
     # -- snapshot support ---------------------------------------------
 

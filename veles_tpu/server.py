@@ -1,0 +1,249 @@
+"""Master server: zmq master--slave data parallelism (DCN compat mode).
+
+Reference parity: veles/server.py — the master owns canonical weights,
+serves minibatch jobs to slaves, aggregates their weight updates, and
+tolerates slaves joining/leaving mid-run (jobs of dead slaves are
+requeued; SURVEY.md §4.2).  The primary TPU distribution mode is SPMD
+over ICI (veles_tpu/parallel/) — this path exists for heterogeneous
+clusters where chips share no ICI/DCN mesh.
+
+Protocol (pickle over zmq REQ/ROUTER):
+
+    slave -> {"type": "handshake"}          -> {"type": "init", params}
+    slave -> {"type": "job_request"}        -> {"type": "job", seq,
+                                                loader, flags, params}
+                                             | {"type": "bye"}
+    slave -> {"type": "job_done", seq, ...} -> {"type": "ack"}
+
+Jobs are issued on demand but their results are APPLIED in issue
+order — Decision then observes exactly the standalone metric sequence
+(with one slave the whole run is bit-identical to standalone).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from collections import OrderedDict
+from typing import Any, Dict
+
+import numpy as np
+
+from veles_tpu.logger import Logger
+
+
+class _Job:
+    __slots__ = ("seq", "payload", "slave", "issued_at", "result")
+
+    def __init__(self, seq: int, payload: dict) -> None:
+        self.seq = seq
+        self.payload = payload
+        self.slave = None
+        self.issued_at = 0.0
+        self.result = None
+
+
+class MasterServer(Logger):
+    def __init__(self, workflow, listen_address: str,
+                 job_timeout: float = 60.0,
+                 linger_s: float = 2.0,
+                 max_ahead: int = 0) -> None:
+        self.workflow = workflow
+        self.listen_address = listen_address
+        self.job_timeout = job_timeout
+        self.linger_s = linger_s
+        #: bound on issued-but-unapplied jobs; 0 = auto (2x slaves).
+        #: Without it a fast slave can race through the whole run
+        #: computing every diff against frozen initial weights while a
+        #: stalled peer blocks in-order application.
+        self.max_ahead = max_ahead
+        self._seq = 0
+        #: issue-ordered ring of outstanding jobs (applied from the head)
+        self._pending: "OrderedDict[int, _Job]" = OrderedDict()
+        self._requeue: list = []
+        self._applied = 0
+        self._slaves: set = set()
+
+    # -- job construction ---------------------------------------------
+
+    def _canonical_params(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """Master-side canonical weights live in the forwards' host
+        Vectors (master never computes minibatches)."""
+        out = {}
+        for f in self.workflow.forwards:
+            p = {}
+            if f.weights:
+                p["weights"] = np.asarray(f.weights.map_read())
+            if f.bias and f.include_bias:
+                p["bias"] = np.asarray(f.bias.map_read())
+            out[f.name] = p
+        return out
+
+    def _apply_diff(self, diff) -> None:
+        for fname, d in diff.items():
+            f = next(u for u in self.workflow.forwards if u.name == fname)
+            for pname, delta in d.items():
+                vec = getattr(f, pname)
+                vec.map_write()
+                vec.mem += delta
+
+    def _issue_payload(self) -> dict:
+        """Advance the loader one minibatch and capture everything the
+        slave needs plus the flag snapshot Decision will need at apply
+        time."""
+        ld = self.workflow.loader
+        ld.run()
+        # the scheduler isn't running on the master — fire the LR
+        # schedule by hand or slaves train at a frozen initial LR
+        lr_adjust = getattr(self.workflow, "lr_adjust", None)
+        if lr_adjust is not None:
+            lr_adjust.run()
+        flags = {"minibatch_class": ld.minibatch_class,
+                 "class_ended": bool(ld.class_ended),
+                 "epoch_ended": bool(ld.epoch_ended),
+                 "last_minibatch": bool(ld.last_minibatch),
+                 "train_ended": bool(ld.train_ended),
+                 "epoch_number": ld.epoch_number}
+        payload = {"loader": ld.generate_data_for_slave(),
+                   "flags": flags,
+                   "params": self._canonical_params(),
+                   "lr_scales": list(self.workflow.fused.lr_scales)
+                   if getattr(self.workflow, "fused", None) else None}
+        return payload
+
+    # -- in-order application -----------------------------------------
+
+    def _apply_ready(self) -> None:
+        ld = self.workflow.loader
+        decision = self.workflow.decision
+        ev = self.workflow.evaluator
+        while self._pending:
+            head = next(iter(self._pending.values()))
+            if head.result is None:
+                break
+            self._pending.popitem(last=False)
+            res = head.result
+            if res.get("params_diff"):
+                self._apply_diff(res["params_diff"])
+            m = res["metrics"]
+            ev.n_err.reset(np.float32([m["n_err"]]))
+            ev.loss.reset(np.float32([m["loss_sum"]]))
+            ev.count.reset(np.float32([m["count"]]))
+            # replay the issue-time loader flags for Decision
+            flags = head.payload["flags"]
+            live = {"minibatch_class": ld.minibatch_class,
+                    "epoch_number": ld.epoch_number,
+                    "class_ended": bool(ld.class_ended),
+                    "epoch_ended": bool(ld.epoch_ended),
+                    "last_minibatch": bool(ld.last_minibatch),
+                    "train_ended": bool(ld.train_ended)}
+            self._set_loader_flags(flags)
+            decision.run()
+            self._set_loader_flags(live)
+            self._applied += 1
+            snap = self.workflow.snapshotter
+            if snap is not None and bool(decision.improved):
+                snap.run()
+
+    def _set_loader_flags(self, flags: dict) -> None:
+        ld = self.workflow.loader
+        ld.minibatch_class = flags["minibatch_class"]
+        ld.epoch_number = flags["epoch_number"]
+        ld.class_ended.set(flags["class_ended"])
+        ld.epoch_ended.set(flags["epoch_ended"])
+        ld.last_minibatch.set(flags["last_minibatch"])
+        ld.train_ended.set(flags["train_ended"])
+
+    # -- elasticity ----------------------------------------------------
+
+    def _reap_dead_jobs(self) -> None:
+        now = time.monotonic()
+        for job in self._pending.values():
+            if job.result is None and job.slave is not None \
+                    and now - job.issued_at > self.job_timeout:
+                self.warning("job %d on slave %r timed out; requeueing",
+                             job.seq, job.slave)
+                dead = job.slave
+                job.slave = None
+                self._slaves.discard(dead)
+                self._requeue.append(job)
+                for u in self.workflow.units:
+                    u_drop = getattr(u, "drop_slave", None)
+                    if u_drop is not None:
+                        u_drop(dead)
+
+    # -- serve loop ----------------------------------------------------
+
+    def serve(self) -> None:
+        import zmq
+
+        w = self.workflow
+        w.loader.host_fill_enabled = False  # indices only on the master
+        decision = w.decision
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.ROUTER)
+        sock.bind(self.listen_address)
+        self.info("master listening on %s", self.listen_address)
+        deadline_idle = None
+        try:
+            while True:
+                if sock.poll(100):
+                    ident, _, raw = sock.recv_multipart()
+                    msg = pickle.loads(raw)
+                    reply = self._handle(msg, ident)
+                    sock.send_multipart([ident, b"",
+                                         pickle.dumps(reply, protocol=4)])
+                self._apply_ready()
+                self._reap_dead_jobs()
+                if bool(decision.complete):
+                    # training is over: outstanding jobs (e.g. held by a
+                    # dead slave) would never unblock the head — discard
+                    # them instead of hanging; late results get "ack"ed
+                    # and ignored
+                    self._pending.clear()
+                    self._requeue.clear()
+                    # grace window so connected slaves get their "bye"
+                    if deadline_idle is None:
+                        deadline_idle = time.monotonic() + self.linger_s
+                    elif time.monotonic() > deadline_idle:
+                        break
+                else:
+                    deadline_idle = None
+        finally:
+            sock.close(0)
+        self.info("master done: %d jobs applied, final valid error %.2f%%",
+                  self._applied, decision.epoch_error_pct[1])
+
+    def _handle(self, msg: dict, ident: bytes) -> dict:
+        kind = msg.get("type")
+        if kind == "handshake":
+            self.info("slave %s connected", msg.get("id", ident.hex()))
+            self._slaves.add(ident)
+            return {"type": "init", "params": self._canonical_params()}
+        if kind == "job_request":
+            # a slave reaped by a conservative job_timeout may still be
+            # alive and requesting — count it again for the issue window
+            self._slaves.add(ident)
+            if bool(self.workflow.decision.complete):
+                return {"type": "bye"}
+            if self._requeue:
+                job = self._requeue.pop(0)
+            elif len(self._pending) >= (self.max_ahead or
+                                        2 * max(len(self._slaves), 1)):
+                # issue window full: the head job is straggling; make
+                # the requester back off instead of training ahead on
+                # stale canonical weights
+                return {"type": "wait", "delay_ms": 20}
+            else:
+                job = _Job(self._seq, self._issue_payload())
+                self._seq += 1
+                self._pending[job.seq] = job
+            job.slave = ident
+            job.issued_at = time.monotonic()
+            return {"type": "job", "seq": job.seq, **job.payload}
+        if kind == "job_done":
+            job = self._pending.get(msg["seq"])
+            if job is not None and job.result is None:
+                job.result = msg
+            return {"type": "ack"}
+        return {"type": "error", "error": f"unknown message {kind!r}"}
