@@ -1,0 +1,160 @@
+"""Plotting units + graphics bus (SURVEY.md §3.1 Graphics bus /
+Plotting units): per-epoch events, file rendering, zmq PUB/SUB."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.graphics_server import (FileRenderer, GraphicsServer,
+                                       get_server, shutdown_server)
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+
+@pytest.fixture(autouse=True)
+def _fresh_server(tmp_path):
+    shutdown_server()
+    server = get_server()
+    server.out_dir = str(tmp_path / "plots")
+    yield server
+    shutdown_server()
+
+
+def build_workflow(max_epochs=2):
+    prng.seed_all(777)
+    train, valid, _ = synthetic_classification(
+        200, 80, (8, 8, 1), n_classes=4, seed=42)
+    w = StandardWorkflow(
+        loader_factory=lambda wf: ArrayLoader(
+            wf, train=train, valid=valid, minibatch_size=40,
+            name="loader"),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": {"learning_rate": 0.1}},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.1}},
+        ],
+        decision_config={"max_epochs": max_epochs},
+        name="plot_test")
+    w.link_plotters()
+    return w
+
+
+class TestFileRenderer:
+    def test_curves(self, tmp_path):
+        r = FileRenderer(str(tmp_path))
+        path = r.render({"kind": "curves", "plotter": "err",
+                         "series": {"train": ([0, 1], [50.0, 20.0])}})
+        assert path and os.path.exists(path)
+
+    def test_matrix(self, tmp_path):
+        r = FileRenderer(str(tmp_path))
+        path = r.render({"kind": "matrix", "plotter": "conf",
+                         "matrix": np.eye(4)})
+        assert path and os.path.exists(path)
+
+    def test_image_grid(self, tmp_path):
+        r = FileRenderer(str(tmp_path))
+        path = r.render({"kind": "image_grid", "plotter": "w",
+                         "tiles": [np.random.rand(8, 8)
+                                   for _ in range(5)]})
+        assert path and os.path.exists(path)
+
+    def test_unknown_kind_ignored(self, tmp_path):
+        r = FileRenderer(str(tmp_path))
+        assert r.render({"kind": "nope", "plotter": "x"}) is None
+
+
+class TestPlottersInWorkflow:
+    def test_workflow_emits_plots(self, _fresh_server):
+        w = build_workflow()
+        w.initialize(device=NumpyDevice())
+        w.run()
+        out = _fresh_server.out_dir
+        made = sorted(os.listdir(out))
+        assert "plt_error.png" in made, made
+        assert "plt_loss.png" in made, made
+        assert "plt_confusion.png" in made, made
+        # 8x8 FC weights are square-able -> weight tiles render too
+        assert "plt_weights.png" in made, made
+
+    def test_plotters_fire_once_per_epoch(self, _fresh_server):
+        events = []
+        _fresh_server.enqueue = lambda e: events.append(e)
+        w = build_workflow(max_epochs=3)
+        w.initialize(device=NumpyDevice())
+        w.run()
+        per = {}
+        for e in events:
+            per[e["plotter"]] = per.get(e["plotter"], 0) + 1
+        assert per["plt_error"] == 3, per
+
+
+class TestSnapshotResume:
+    def test_resumed_plotters_still_fire(self, _fresh_server):
+        """Pickling flattens derived gate Bools to frozen values; the
+        re-wiring at initialize must re-derive plotter gates or resumed
+        runs plot never/always (regression for the frozen-gate bug)."""
+        import pickle
+        w = build_workflow(max_epochs=1)
+        w.initialize(device=NumpyDevice())
+        w.run()
+        w2 = pickle.loads(pickle.dumps(w))
+        events = []
+        _fresh_server.enqueue = lambda e: events.append(e)
+        w2.decision.max_epochs = 3
+        w2.decision.complete.set(False)  # it finished; train 2 more
+        w2.initialize(device=NumpyDevice())
+        w2.run()
+        n_err_events = sum(1 for e in events
+                           if e["plotter"] == "plt_error")
+        assert n_err_events == 2, (n_err_events, len(events))
+
+    def test_confusion_is_per_epoch(self, _fresh_server):
+        """Decision snapshots + zeroes the evaluator's confusion at
+        each class end — totals must equal ONE epoch's sample count,
+        not the whole run's."""
+        w = build_workflow(max_epochs=3)
+        w.initialize(device=NumpyDevice())
+        w.run()
+        from veles_tpu.loader.base import VALID
+        conf = w.decision.confusion_per_class[VALID]
+        assert conf is not None
+        assert conf.sum() == 80  # one validation epoch, not 3x
+
+
+class TestPubSub:
+    def test_zmq_roundtrip(self, tmp_path):
+        import socket as pysocket
+
+        from veles_tpu.graphics_client import GraphicsClient
+
+        with pysocket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        endpoint = f"tcp://127.0.0.1:{port}"
+        server = GraphicsServer(endpoint=endpoint,
+                                out_dir=str(tmp_path / "srv"),
+                                render=False)
+        client = GraphicsClient(endpoint, str(tmp_path / "cli"))
+        got = []
+        t = threading.Thread(target=lambda: got.append(
+            client.serve(max_events=1)), daemon=True)
+        t.start()
+        # PUB/SUB needs the subscription to land; retry until delivery
+        import time
+        for _ in range(100):
+            server.enqueue({"kind": "curves", "plotter": "live",
+                            "series": {"t": ([0], [1.0])}})
+            if not t.is_alive():
+                break
+            time.sleep(0.05)
+        t.join(timeout=5)
+        assert got == [1]
+        assert os.path.exists(tmp_path / "cli" / "live.png")
+        server.close()

@@ -1,0 +1,89 @@
+"""Web status dashboard (SURVEY.md §3.1 Web status): HTTP API,
+dashboard rendering, per-epoch reporting from a live workflow."""
+
+import json
+import urllib.request
+
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+from veles_tpu.web_status import WebStatusServer
+
+
+@pytest.fixture
+def server():
+    s = WebStatusServer(port=0, host="127.0.0.1")
+    s.start_background()
+    yield s
+    s.shutdown()
+
+
+def url(server, path):
+    return f"http://127.0.0.1:{server.port}{path}"
+
+
+def get_json(server, path):
+    with urllib.request.urlopen(url(server, path), timeout=5) as r:
+        return json.loads(r.read())
+
+
+class TestApi:
+    def test_empty_status(self, server):
+        assert get_json(server, "/api/status") == {}
+
+    def test_update_roundtrip(self, server):
+        body = json.dumps({"id": "r1", "name": "w", "epoch": 3,
+                           "train_error_pct": 12.5}).encode()
+        req = urllib.request.Request(
+            url(server, "/api/update"), data=body,
+            headers={"Content-Type": "application/json"})
+        assert json.loads(urllib.request.urlopen(
+            req, timeout=5).read()) == {"ok": True}
+        runs = get_json(server, "/api/status")
+        assert runs["r1"]["epoch"] == 3
+
+    def test_bad_update_is_400(self, server):
+        req = urllib.request.Request(
+            url(server, "/api/update"), data=b"{not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
+
+    def test_dashboard_html(self, server):
+        body = json.dumps({"id": "r2", "name": "MyNet",
+                           "epoch": 7}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            url(server, "/api/update"), data=body), timeout=5)
+        with urllib.request.urlopen(url(server, "/"), timeout=5) as r:
+            html = r.read().decode()
+        assert "MyNet" in html and "<table>" in html
+
+
+class TestWorkflowReporting:
+    def test_workflow_posts_per_epoch(self, server):
+        prng.seed_all(777)
+        train, valid, _ = synthetic_classification(
+            200, 80, (8, 8, 1), n_classes=4, seed=42)
+        w = StandardWorkflow(
+            loader_factory=lambda wf: ArrayLoader(
+                wf, train=train, valid=valid, minibatch_size=40,
+                name="loader"),
+            layers=[{"type": "softmax",
+                     "->": {"output_sample_shape": 4},
+                     "<-": {"learning_rate": 0.1}}],
+            decision_config={"max_epochs": 3}, name="status_wf")
+        w.link_status_reporter(url(server, ""), mode="standalone")
+        w.initialize(device=NumpyDevice())
+        w.run()
+        runs = get_json(server, "/api/status")
+        assert len(runs) == 1
+        (row,) = runs.values()
+        assert row["name"] == "status_wf"
+        assert row["epoch"] == 3
+        assert row["complete"] is True
+        assert row["valid_error_pct"] < 100.0

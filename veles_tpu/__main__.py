@@ -42,6 +42,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run as zmq slave of this master (DCN compat)")
     p.add_argument("--listen-address", default=None,
                    help="run as zmq master listening here (DCN compat)")
+    p.add_argument("-p", "--plotters", action="store_true",
+                   help="render per-epoch plots (error/loss curves, "
+                        "confusion, weight tiles) to $VELES_PLOTS_DIR")
+    p.add_argument("--plots-endpoint", default=None,
+                   help="also publish plot events on this zmq PUB "
+                        "endpoint for live graphics_client viewers")
+    p.add_argument("--status-server", default=None,
+                   help="POST per-epoch status to this web_status "
+                        "dashboard (http://host:port)")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("--dump-config", action="store_true",
                    help="print the effective config tree and exit")
@@ -61,10 +70,18 @@ def main(argv=None) -> int:
         apply_config_file(cf)
     parse_overrides(overrides)
 
+    if args.plots_endpoint:
+        from veles_tpu import graphics_server
+        server = graphics_server.get_server()
+        server.endpoint = args.plots_endpoint
+        server.bind()
+        args.plotters = True  # an endpoint without plotters is silence
+
     launcher = Launcher(
         backend=args.backend, seed=args.seed, snapshot=args.snapshot,
         dp=args.dp, master_address=args.master_address,
         listen_address=args.listen_address, multihost=args.multihost,
+        plotters=args.plotters, status_server=args.status_server,
         verbose=args.verbose)
 
     if args.dump_config:

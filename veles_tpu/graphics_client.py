@@ -1,0 +1,62 @@
+"""Graphics client: subscribes to a GraphicsServer PUB endpoint and
+renders incoming plot events to files (or a live GUI when a display
+exists).
+
+Reference parity: veles/graphics_client.py — the separate matplotlib
+process attached to the plot event bus.  Run it on a workstation while
+training runs headless:
+
+    python -m veles_tpu.graphics_client tcp://trainhost:5005 [out_dir]
+"""
+
+from __future__ import annotations
+
+import pickle
+import sys
+
+from veles_tpu.graphics_server import FileRenderer
+from veles_tpu.logger import Logger, setup_logging
+
+
+class GraphicsClient(Logger):
+    def __init__(self, endpoint: str, out_dir: str = "plots") -> None:
+        self.endpoint = endpoint
+        self.renderer = FileRenderer(out_dir)
+
+    def serve(self, max_events: int = 0) -> int:
+        import zmq
+
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.SUB)
+        sock.setsockopt(zmq.SUBSCRIBE, b"")
+        sock.connect(self.endpoint)
+        self.info("subscribed to %s", self.endpoint)
+        n = 0
+        try:
+            while True:
+                event = pickle.loads(sock.recv())
+                path = self.renderer.render(event)
+                if path:
+                    self.info("rendered %s", path)
+                n += 1
+                if max_events and n >= max_events:
+                    break
+        except KeyboardInterrupt:
+            pass
+        finally:
+            sock.close(0)
+        return n
+
+
+def main() -> int:
+    setup_logging()
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 2
+    out = sys.argv[2] if len(sys.argv) > 2 else "plots"
+    GraphicsClient(sys.argv[1], out).serve()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,149 @@
+"""Graphics event bus: zmq PUB of plot events + in-process renderer.
+
+Reference parity: veles/graphics_server.py — plotting units enqueue
+plot events; a zmq PUB socket broadcasts them to a separate matplotlib
+client process (veles/graphics_client.py), with a file/PDF output mode
+(SURVEY.md §3.1 "Graphics bus").
+
+TPU adaptation: the default sink renders to PNG/PDF files in-process
+with the Agg backend (headless training hosts); the PUB socket is kept
+so external live viewers (graphics_client.py) can attach over DCN
+exactly like the reference's GUI client.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+from veles_tpu.logger import Logger
+
+_server: Optional["GraphicsServer"] = None
+
+
+def get_server() -> "GraphicsServer":
+    global _server
+    if _server is None:
+        _server = GraphicsServer()
+    return _server
+
+
+def shutdown_server() -> None:
+    global _server
+    if _server is not None:
+        _server.close()
+        _server = None
+
+
+class GraphicsServer(Logger):
+    """Publishes plot events; optionally renders them to files."""
+
+    def __init__(self, endpoint: Optional[str] = None,
+                 out_dir: Optional[str] = None,
+                 render: bool = True) -> None:
+        self.endpoint = endpoint
+        self.out_dir = out_dir or os.environ.get(
+            "VELES_PLOTS_DIR", "plots")
+        self.render = render
+        self._sock = None
+        self._renderer = None
+
+    def _ensure_sock(self):
+        if self.endpoint and self._sock is None:
+            import zmq
+            ctx = zmq.Context.instance()
+            self._sock = ctx.socket(zmq.PUB)
+            self._sock.bind(self.endpoint)
+            self.info("graphics PUB bound on %s", self.endpoint)
+        return self._sock
+
+    def bind(self) -> None:
+        """Bind the PUB endpoint eagerly so live viewers can attach
+        before the first plot event."""
+        self._ensure_sock()
+
+    def enqueue(self, event: Dict[str, Any]) -> None:
+        """event: {"plotter": name, "kind": ..., payload...}."""
+        sock = self._ensure_sock()
+        if sock is not None:
+            sock.send(pickle.dumps(event, protocol=4))
+        if self.render:
+            if self._renderer is None:
+                self._renderer = FileRenderer(self.out_dir)
+            self._renderer.render(event)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close(0)
+            self._sock = None
+
+
+class FileRenderer(Logger):
+    """Renders plot events to PNG files with matplotlib Agg.
+
+    One file per plotter name, overwritten as the run progresses —
+    the reference's file/PDF output mode.
+    """
+
+    def __init__(self, out_dir: str) -> None:
+        self.out_dir = out_dir
+        self._have_mpl = None
+
+    def _plt(self):
+        if self._have_mpl is None:
+            try:
+                import matplotlib
+                matplotlib.use("Agg", force=True)
+                import matplotlib.pyplot as plt
+                self._have_mpl = plt
+            except Exception:  # matplotlib genuinely absent
+                self.warning("matplotlib unavailable; plots disabled")
+                self._have_mpl = False
+        return self._have_mpl
+
+    def render(self, event: Dict[str, Any]) -> Optional[str]:
+        plt = self._plt()
+        if not plt:
+            return None
+        os.makedirs(self.out_dir, exist_ok=True)
+        kind = event.get("kind")
+        fig = plt.figure(figsize=event.get("figsize", (6, 4)))
+        try:
+            ax = fig.add_subplot(111)
+            if kind == "curves":
+                for label, (xs, ys) in event["series"].items():
+                    ax.plot(xs, ys, label=label)
+                ax.set_xlabel(event.get("xlabel", "epoch"))
+                ax.set_ylabel(event.get("ylabel", ""))
+                if event["series"]:
+                    ax.legend(loc="best", fontsize=8)
+                ax.grid(True, alpha=0.3)
+            elif kind == "matrix":
+                im = ax.imshow(event["matrix"], cmap="viridis",
+                               interpolation="nearest")
+                fig.colorbar(im, ax=ax)
+                ax.set_xlabel(event.get("xlabel", "predicted"))
+                ax.set_ylabel(event.get("ylabel", "target"))
+            elif kind == "image_grid":
+                import numpy as np
+                fig.clf()
+                tiles = event["tiles"]
+                n = len(tiles)
+                cols = int(np.ceil(np.sqrt(n)))
+                rows = int(np.ceil(n / cols))
+                for i, tile in enumerate(tiles):
+                    sub = fig.add_subplot(rows, cols, i + 1)
+                    sub.imshow(tile, cmap=event.get("cmap", "gray"))
+                    sub.set_xticks(())
+                    sub.set_yticks(())
+            else:
+                return None
+            title = event.get("title", event.get("plotter", "plot"))
+            fig.suptitle(title, fontsize=10)
+            path = os.path.join(
+                self.out_dir, f"{event.get('plotter', 'plot')}.png")
+            fig.savefig(path, dpi=100)
+            return path
+        finally:
+            plt.close(fig)

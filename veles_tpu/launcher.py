@@ -33,6 +33,8 @@ class Launcher(Logger):
                  master_address: Optional[str] = None,
                  listen_address: Optional[str] = None,
                  multihost: bool = False,
+                 plotters: bool = False,
+                 status_server: Optional[str] = None,
                  verbose: bool = False,
                  **kwargs: Any) -> None:
         setup_logging(10 if verbose else 20)
@@ -42,6 +44,8 @@ class Launcher(Logger):
         self.master_address = master_address
         self.listen_address = listen_address
         self.workflow = None
+        self.plotters = plotters
+        self.status_server = status_server
         prng.seed_all(seed)
         if multihost:
             import jax
@@ -69,6 +73,12 @@ class Launcher(Logger):
             self.workflow = load_workflow(self.snapshot)
         else:
             self.workflow = factory(self, **kwargs)
+        if self.plotters and hasattr(self.workflow, "link_plotters"):
+            self.workflow.link_plotters()
+        if self.status_server and hasattr(self.workflow,
+                                          "link_status_reporter"):
+            self.workflow.link_status_reporter(self.status_server,
+                                               mode=self.mode)
         return self.workflow
 
     def initialize(self, **kwargs: Any) -> None:

@@ -54,6 +54,10 @@ class DecisionGD(Unit, Distributable):
         self.min_train_error = float("inf")
         #: per-epoch history rows (epoch, class, n_err, loss, error%)
         self.history: List[dict] = []
+        #: last COMPLETED class's confusion matrix, per class — the
+        #: evaluator's accumulator is zeroed at each class end so the
+        #: matrix is per-class-per-epoch, not a run-cumulative blur
+        self.confusion_per_class: List[Any] = [None, None, None]
 
     # -- metric intake -------------------------------------------------
 
@@ -91,6 +95,10 @@ class DecisionGD(Unit, Distributable):
         ld = self.loader
         if bool(ld.class_ended):
             klass = ld.minibatch_class
+            conf = getattr(ev, "confusion", None) if ev else None
+            if conf:
+                self.confusion_per_class[klass] = conf.mem.copy()
+                conf.mem[:] = 0
             self._flush_class(klass)
             self.info("epoch %d %s: n_err=%g loss=%.6f error=%.2f%%",
                       ld.epoch_number, CLASS_NAMES[klass],

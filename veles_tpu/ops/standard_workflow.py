@@ -70,6 +70,45 @@ class StandardWorkflow(NNWorkflow):
             self.lr_adjust.gds = self.gds
             self.lr_adjust.fused = self.fused
         self._extra_after_decision: list = []
+        self.plotters: list = []
+
+    # -- plotters ------------------------------------------------------
+
+    def link_plotters(self) -> None:
+        """Attach the reference's standard plotters (error curves,
+        confusion matrix, first-layer weight images); they fire once per
+        epoch after Decision and render through the graphics bus
+        (reference: StandardWorkflow.link_plotters)."""
+        if self.plotters:
+            return
+        from veles_tpu.plotting_units import (AccumulatingPlotter,
+                                              MatrixPlotter, Weights2D)
+        ps = [AccumulatingPlotter(self, name="plt_error"),
+              AccumulatingPlotter(self, field="loss", name="plt_loss")]
+        # the confusion Vector is allocated at initialize(), after this
+        # runs — gate on the evaluator's intent, not the buffer
+        if getattr(self.evaluator, "compute_confusion", False):
+            ps.append(MatrixPlotter(self, evaluator=self.evaluator,
+                                    name="plt_confusion"))
+        ps.append(Weights2D(self, unit=self.forwards[0],
+                            name="plt_weights"))
+        for p in ps:
+            p.link_decision(self.decision)
+        self._extra_after_decision.extend(ps)
+        self.plotters = ps
+
+    def link_status_reporter(self, url: str,
+                             mode: str = "standalone") -> None:
+        """Attach a per-epoch POST to a web-status dashboard
+        (reference: veles/web_status.py client side)."""
+        from veles_tpu.web_status import StatusReporter
+        if any(type(u) is StatusReporter
+               for u in self._extra_after_decision):
+            return  # snapshot resume: the pickled reporter stays
+        rep = StatusReporter(self, url=url, mode=mode,
+                             name="status_reporter")
+        rep.link_decision(self.decision)
+        self._extra_after_decision.append(rep)
 
     # -- unit creation -------------------------------------------------
 
@@ -146,6 +185,11 @@ class StandardWorkflow(NNWorkflow):
         for gd in self.gds:
             gd.gate_skip = Bool.from_expr(
                 lambda ld=loader: ld.minibatch_class != TRAIN)
+        # plotters / status reporters carry the same pickled-frozen-gate
+        # hazard — re-derive their gates from the live decision too
+        for extra in self._extra_after_decision:
+            if hasattr(extra, "link_decision"):
+                extra.link_decision(self.decision)
 
     def _wire_common_tail(self, before_decision) -> None:
         self.decision.link_from(before_decision)

@@ -1,0 +1,140 @@
+"""Plotting units: error curves, confusion matrix, weight images.
+
+Reference parity: veles/plotting_units.py + veles/plotter.py —
+``AccumulatingPlotter`` (error/loss curves over epochs),
+``MatrixPlotter`` (confusion matrix), ``Weights2D`` (first-layer filter
+images).  Units sit after Decision in the control graph and fire once
+per train-epoch end; each emits a plot event onto the graphics bus
+(veles_tpu/graphics_server.py) which renders to files and/or publishes
+to live zmq subscribers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from veles_tpu.graphics_server import get_server
+from veles_tpu.loader.base import CLASS_NAMES
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+
+class Plotter(Unit):
+    """Base plotting unit: skipped unless the epoch just ended."""
+
+    def __init__(self, workflow=None, **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.decision = None
+
+    def link_decision(self, decision) -> None:
+        self.decision = decision
+        self.gate_skip = Bool.from_expr(
+            lambda d=decision: not bool(d.epoch_ended_flag))
+
+    def make_event(self) -> Optional[dict]:
+        raise NotImplementedError
+
+    def run(self) -> None:
+        event = self.make_event()
+        if event is not None:
+            event.setdefault("plotter", self.name)
+            get_server().enqueue(event)
+
+
+class AccumulatingPlotter(Plotter):
+    """Error% (or loss) curves per class over epochs, from Decision's
+    history rows."""
+
+    def __init__(self, workflow=None, field: str = "error_pct",
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.field = field
+
+    def make_event(self) -> Optional[dict]:
+        series = {}
+        for klass_name in CLASS_NAMES:
+            rows = [h for h in self.decision.history
+                    if h["class"] == klass_name]
+            if rows:
+                series[klass_name] = ([h["epoch"] for h in rows],
+                                      [h[self.field] for h in rows])
+        if not series:
+            return None
+        return {"kind": "curves", "series": series,
+                "ylabel": self.field,
+                "title": f"{self.workflow.name}: {self.field}"}
+
+
+class MatrixPlotter(Plotter):
+    """Confusion matrix heat map for the last completed validation (or
+    train) epoch; Decision stashes per-class snapshots so the plot is
+    per-epoch, not run-cumulative."""
+
+    def __init__(self, workflow=None, evaluator=None,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.evaluator = evaluator
+
+    def make_event(self) -> Optional[dict]:
+        from veles_tpu.loader.base import TRAIN, VALID
+        per = getattr(self.decision, "confusion_per_class", None)
+        conf = None
+        klass = None
+        if per is not None:
+            for klass in (VALID, TRAIN):
+                if per[klass] is not None:
+                    conf = per[klass]
+                    break
+        if conf is None:
+            return None
+        return {"kind": "matrix", "matrix": np.asarray(conf),
+                "title": f"{self.workflow.name}: confusion "
+                         f"({CLASS_NAMES[klass]})"}
+
+
+class Weights2D(Plotter):
+    """First-layer weights rendered as image tiles (the reference's
+    filter-visualization plotter)."""
+
+    def __init__(self, workflow=None, unit=None, limit: int = 25,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.unit = unit
+        self.limit = limit
+
+    def make_event(self) -> Optional[dict]:
+        f = self.unit
+        if f is None or not f.weights:
+            return None
+        w = np.asarray(f.weights.map_read())
+        tiles = self._to_tiles(w)
+        if tiles is None:
+            return None
+        return {"kind": "image_grid", "tiles": tiles[:self.limit],
+                "title": f"{self.workflow.name}: {f.name} weights"}
+
+    @staticmethod
+    def _to_tiles(w: np.ndarray) -> Optional[List[np.ndarray]]:
+        if w.ndim == 4:            # conv HWIO -> one tile per out-chan
+            h, kw, cin, cout = w.shape
+            t = np.transpose(w, (3, 0, 1, 2))
+            if cin == 3:
+                lo, hi = t.min(), t.max()
+                return list((t - lo) / max(hi - lo, 1e-12))
+            return list(t.mean(-1))
+        if w.ndim == 2:            # FC: rows reshaped if square-able
+            n = w.shape[0]
+            side = int(np.sqrt(n))
+            if side * side == n:
+                return list(np.transpose(
+                    w.reshape(side, side, -1), (2, 0, 1)))
+            side3 = int(np.sqrt(n // 3)) if n % 3 == 0 else 0
+            if side3 and side3 * side3 * 3 == n:
+                t = np.transpose(w.reshape(side3, side3, 3, -1),
+                                 (3, 0, 1, 2))
+                lo, hi = t.min(), t.max()
+                return list((t - lo) / max(hi - lo, 1e-12))
+            return None
+        return None

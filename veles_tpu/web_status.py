@@ -1,0 +1,191 @@
+"""Web status dashboard.
+
+Reference parity: veles/web_status.py — a web server showing all
+running workflows; each run POSTs periodic status updates (SURVEY.md
+§3.1 "Web status").  Rebuilt on the stdlib http.server (no Tornado in
+this environment): GET / renders an auto-refreshing dashboard, GET
+/api/status returns JSON, POST /api/update ingests a workflow's status.
+
+Standalone:   python -m veles_tpu.web_status [port]
+In training:  --status-server http://host:port on the CLI attaches a
+              StatusReporter unit that POSTs after every epoch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from veles_tpu.logger import Logger
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu status</title>
+<meta http-equiv="refresh" content="2">
+<style>
+ body {{ font-family: monospace; background: #111; color: #ddd; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ border: 1px solid #444; padding: 6px 10px; text-align: left; }}
+ th {{ background: #222; }}
+ .stale {{ color: #777; }}
+</style></head>
+<body><h2>veles_tpu — running workflows</h2>
+<table><tr><th>workflow</th><th>mode</th><th>epoch</th>
+<th>train err%</th><th>valid err%</th><th>min valid err</th>
+<th>updated</th></tr>
+{rows}
+</table></body></html>
+"""
+
+
+class StatusStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._runs: Dict[str, Dict[str, Any]] = {}
+
+    def update(self, run_id: str, data: Dict[str, Any]) -> None:
+        with self._lock:
+            data = dict(data)
+            data["updated_at"] = time.time()
+            self._runs[run_id] = data
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._runs.items()}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: StatusStore = None  # type: ignore  # set by server
+
+    def log_message(self, fmt, *args):  # silence per-request stderr
+        pass
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "text/html") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        runs = self.store.snapshot()
+        if self.path.startswith("/api/status"):
+            self._send(200, json.dumps(runs).encode(),
+                       "application/json")
+            return
+        now = time.time()
+        rows = []
+        for rid, r in sorted(runs.items()):
+            age = now - r.get("updated_at", 0)
+            cls = ' class="stale"' if age > 30 else ""
+            rows.append(
+                f"<tr{cls}><td>{r.get('name', rid)}</td>"
+                f"<td>{r.get('mode', '?')}</td>"
+                f"<td>{r.get('epoch', '?')}</td>"
+                f"<td>{r.get('train_error_pct', '')}</td>"
+                f"<td>{r.get('valid_error_pct', '')}</td>"
+                f"<td>{r.get('min_valid_error', '')}</td>"
+                f"<td>{int(age)}s ago</td></tr>")
+        self._send(200, _PAGE.format(rows="\n".join(rows)).encode())
+
+    def do_POST(self) -> None:
+        if not self.path.startswith("/api/update"):
+            self._send(404, b"not found", "text/plain")
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            data = json.loads(self.rfile.read(length))
+            self.store.update(data["id"], data)
+            self._send(200, b'{"ok": true}', "application/json")
+        except (ValueError, KeyError) as e:
+            self._send(400, json.dumps({"error": str(e)}).encode(),
+                       "application/json")
+
+
+class WebStatusServer(Logger):
+    def __init__(self, port: int = 8090, host: str = "0.0.0.0") -> None:
+        self.store = StatusStore()
+        handler = type("Handler", (_Handler,), {"store": self.store})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+
+    def serve_forever(self) -> None:
+        self.info("web status on http://0.0.0.0:%d", self.port)
+        self.httpd.serve_forever()
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.httpd.serve_forever,
+                             daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class StatusReporter(Unit):
+    """Fires after Decision once per epoch; POSTs workflow status to a
+    web-status server (reference: workflows POST periodic updates)."""
+
+    def __init__(self, workflow=None, url: str = "",
+                 mode: str = "standalone", **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.url = url.rstrip("/")
+        self.mode = mode
+        self.run_id = f"{workflow.name if workflow else 'run'}-{id(self):x}"
+        self.decision = None
+        self.failures = 0
+
+    def link_decision(self, decision) -> None:
+        self.decision = decision
+        self.gate_skip = Bool.from_expr(
+            lambda d=decision: not bool(d.epoch_ended_flag))
+
+    def payload(self) -> Dict[str, Any]:
+        d = self.decision
+        return {"id": self.run_id,
+                "name": self.workflow.name,
+                "mode": self.mode,
+                "epoch": d.loader.epoch_number,
+                "train_error_pct": round(d.epoch_error_pct[2], 2),
+                "valid_error_pct": round(d.epoch_error_pct[1], 2),
+                "min_valid_error": d.min_valid_error
+                if d.min_valid_error != float("inf") else None,
+                "complete": bool(d.complete)}
+
+    def run(self) -> None:
+        import urllib.request
+
+        body = json.dumps(self.payload()).encode()
+        req = urllib.request.Request(
+            f"{self.url}/api/update", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=2).read()
+        except OSError as e:
+            self.failures += 1
+            if self.failures <= 3:  # don't spam a dead dashboard
+                self.warning("status POST failed: %s", e)
+
+
+def main() -> int:
+    import sys
+
+    from veles_tpu.logger import setup_logging
+
+    setup_logging()
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 8090
+    WebStatusServer(port=port).serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
