@@ -1,0 +1,180 @@
+"""GA hyperparameter tuner (veles_tpu/genetics/) and ensemble
+(veles_tpu/ensemble/) — SURVEY.md §3.1 Genetics / Ensemble."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import JaxDevice, NumpyDevice
+from veles_tpu.config import Config
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.ensemble import EnsemblePredictor, EnsembleTrainer
+from veles_tpu.genetics import (GeneticOptimizer, Tune, find_tunes,
+                                substitute_tunes)
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+
+class TestTune:
+    def test_int_vs_float(self):
+        assert Tune(16, 4, 64).is_int
+        assert not Tune(0.1, 0.01, 1.0).is_int
+        assert Tune(0.1, 0.001, 1.0).log_scale
+        assert not Tune(0.5, 0.0, 1.0).log_scale
+
+    def test_clip(self):
+        t = Tune(16, 4, 64)
+        assert t.clip(999) == 64
+        assert t.clip(5.4) == 5
+        assert Tune(0.1, 0.01, 1.0).clip(2.0) == 1.0
+
+    def test_bad_range(self):
+        with pytest.raises(ValueError):
+            Tune(1, 5, 2)
+
+
+class TestTreeWalking:
+    def make_tree(self):
+        cfg = Config("root")
+        cfg.model.lr = Tune(0.1, 0.01, 1.0)
+        cfg.model.layers = [
+            {"type": "all2all", "->": {"out": Tune(32, 8, 128)}}]
+        cfg.plain = 5
+        return cfg
+
+    def test_find(self):
+        tunes = find_tunes(self.make_tree())
+        assert set(tunes) == {"model.lr",
+                              "model.layers[0]['->']['out']"}
+
+    def test_substitute(self):
+        cfg = self.make_tree()
+        tunes = find_tunes(cfg)
+        substitute_tunes(cfg, {p: t.value for p, t in tunes.items()})
+        assert cfg.model.lr == 0.1
+        assert cfg.model.layers[0]["->"]["out"] == 32
+        assert not find_tunes(cfg)
+
+
+class TestGeneticOptimizer:
+    def test_optimizes_quadratic(self):
+        """GA must find the minimum of a smooth 2-var function."""
+        prng.seed_all(99)
+        tunes = {"x": Tune(5.0, -10.0, 10.0),
+                 "y": Tune(-3.0, -10.0, 10.0)}
+        calls = []
+
+        def f(v):
+            calls.append(v)
+            return (v["x"] - 2.0) ** 2 + (v["y"] + 1.0) ** 2
+
+        opt = GeneticOptimizer(f, tunes, population=12, generations=10)
+        best, fit = opt.run()
+        assert fit < 0.5, (best, fit)
+        assert abs(best["x"] - 2.0) < 1.0
+        assert abs(best["y"] + 1.0) < 1.0
+
+    def test_int_genes_stay_int(self):
+        prng.seed_all(99)
+        tunes = {"n": Tune(16, 4, 64)}
+        opt = GeneticOptimizer(lambda v: abs(v["n"] - 32), tunes,
+                               population=8, generations=8)
+        best, fit = opt.run()
+        assert isinstance(best["n"], int)
+        # must improve on the default individual's fitness (|16-32|=16)
+        assert fit < 16
+
+    def test_failed_evaluations_survive(self):
+        prng.seed_all(99)
+        tunes = {"x": Tune(0.5, 0.0, 1.0)}
+
+        def f(v):
+            if v["x"] > 0.5:
+                raise RuntimeError("boom")
+            return v["x"]
+
+        opt = GeneticOptimizer(f, tunes, population=6, generations=3)
+        best, fit = opt.run()
+        assert np.isfinite(fit)
+        assert best["x"] <= 0.5
+
+    def test_requires_tunes(self):
+        with pytest.raises(ValueError, match="no Tune"):
+            GeneticOptimizer(lambda v: 0.0, {})
+
+    def test_tunes_lr_of_real_workflow(self):
+        """End-to-end: GA over the learning rate of a tiny workflow —
+        the best LR must beat a pathologically small default."""
+        prng.seed_all(99)
+        train, valid, _ = synthetic_classification(
+            200, 80, (8, 8, 1), n_classes=4, seed=42)
+
+        def evaluate(values):
+            prng.seed_all(1234)
+            w = StandardWorkflow(
+                loader_factory=lambda wf: ArrayLoader(
+                    wf, train=train, valid=valid, minibatch_size=40,
+                    name="loader"),
+                layers=[{"type": "softmax",
+                         "->": {"output_sample_shape": 4},
+                         "<-": {"learning_rate": values["lr"]}}],
+                decision_config={"max_epochs": 3}, name="ga_wf")
+            w.initialize(device=JaxDevice(platform="cpu"))
+            w.run()
+            return w.decision.min_valid_error
+
+        tunes = {"lr": Tune(1e-4, 1e-4, 2.0)}
+        baseline = evaluate({"lr": 1e-4})
+        opt = GeneticOptimizer(evaluate, tunes, population=8,
+                               generations=3)
+        best, fit = opt.run()
+        assert fit < baseline, (fit, baseline)
+        assert best["lr"] > 1e-3
+
+
+def _member_factory(train, valid):
+    def factory():
+        return StandardWorkflow(
+            loader_factory=lambda wf: ArrayLoader(
+                wf, train=train, valid=valid, minibatch_size=40,
+                name="loader"),
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 16},
+                 "<-": {"learning_rate": 0.1}},
+                {"type": "softmax", "->": {"output_sample_shape": 4},
+                 "<-": {"learning_rate": 0.1}},
+            ],
+            decision_config={"max_epochs": 4}, name="member")
+    return factory
+
+
+class TestEnsemble:
+    def test_train_and_aggregate(self):
+        train, valid, _ = synthetic_classification(
+            300, 100, (8, 8, 1), n_classes=4, seed=42)
+        factory = _member_factory(train, valid)
+        trainer = EnsembleTrainer(factory,
+                                  lambda: JaxDevice(platform="cpu"),
+                                  n_members=3, base_seed=555)
+        members = trainer.train()
+        assert len(members) == 3
+        # seeds differ -> members differ
+        w0 = members[0]["params"]["fwd0_all2all_tanh"]["weights"]
+        w1 = members[1]["params"]["fwd0_all2all_tanh"]["weights"]
+        assert not np.allclose(w0, w1)
+
+        pred = EnsemblePredictor(factory,
+                                 lambda: JaxDevice(platform="cpu"),
+                                 members)
+        x_valid, y_valid = valid
+        ens_err = pred.error_pct(x_valid, y_valid)
+        worst = max(m["valid_error"] for m in members)
+        # the ensemble must at least not be worse than the worst member
+        assert ens_err <= worst + 1e-9, (ens_err, worst)
+        proba = pred.predict_proba(x_valid[:5])
+        np.testing.assert_allclose(proba.sum(-1), 1.0, atol=1e-5)
+
+    def test_empty_ensemble_rejected(self):
+        with pytest.raises(ValueError, match="empty"):
+            EnsemblePredictor(lambda: None, lambda: None, [])

@@ -115,6 +115,25 @@ class TestSnapshotResume:
                            if e["plotter"] == "plt_error")
         assert n_err_events == 2, (n_err_events, len(events))
 
+    def test_old_snapshot_without_new_attrs_resumes(self):
+        """Snapshots written before _extra_after_decision/plotters/
+        confusion_per_class existed must still resume (the __setstate__
+        defaults)."""
+        import pickle
+        w = build_workflow(max_epochs=1)
+        w.initialize(device=NumpyDevice())
+        w.run()
+        # simulate an old snapshot: these attrs did not exist back then
+        del w.__dict__["_extra_after_decision"]
+        del w.__dict__["plotters"]
+        del w.decision.__dict__["confusion_per_class"]
+        w2 = pickle.loads(pickle.dumps(w))
+        w2.decision.complete.set(False)
+        w2.decision.max_epochs = 2
+        w2.initialize(device=NumpyDevice())
+        w2.run()
+        assert len(w2.decision.history) >= 4
+
     def test_confusion_is_per_epoch(self, _fresh_server):
         """Decision snapshots + zeroes the evaluator's confusion at
         each class end — totals must equal ONE epoch's sample count,

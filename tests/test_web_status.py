@@ -54,6 +54,26 @@ class TestApi:
             urllib.request.urlopen(req, timeout=5)
         assert e.value.code == 400
 
+    def test_nondict_update_is_400(self, server):
+        req = urllib.request.Request(
+            url(server, "/api/update"), data=b"[1, 2]",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
+
+    def test_dashboard_escapes_html(self, server):
+        """Names come from unauthenticated POSTs — they must never
+        reach the page as markup."""
+        evil = "<script>alert(1)</script>"
+        body = json.dumps({"id": "r9", "name": evil}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            url(server, "/api/update"), data=body), timeout=5)
+        with urllib.request.urlopen(url(server, "/"), timeout=5) as r:
+            html_page = r.read().decode()
+        assert evil not in html_page
+        assert "&lt;script&gt;" in html_page
+
     def test_dashboard_html(self, server):
         body = json.dumps({"id": "r2", "name": "MyNet",
                            "epoch": 7}).encode()

@@ -48,6 +48,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--plots-endpoint", default=None,
                    help="also publish plot events on this zmq PUB "
                         "endpoint for live graphics_client viewers")
+    p.add_argument("--optimize", default=None, metavar="POP:GEN",
+                   help="GA-tune config values wrapped in Tune(...): "
+                        "population size : generations (e.g. 8:5)")
     p.add_argument("--status-server", default=None,
                    help="POST per-epoch status to this web_status "
                         "dashboard (http://host:port)")
@@ -89,6 +92,9 @@ def main(argv=None) -> int:
         root.print_()
         return 0
 
+    if args.optimize:
+        return run_optimizer(args, workflow_file)
+
     mod = load_workflow_module(workflow_file)
     if hasattr(mod, "run"):
         mod.run(launcher)
@@ -100,6 +106,57 @@ def main(argv=None) -> int:
         print(f"{workflow_file}: defines neither run(launcher) nor "
               "create_workflow(launcher)", file=sys.stderr)
         return 2
+    return 0
+
+
+def run_optimizer(args, workflow_file: str) -> int:
+    """GA mode (reference: veles --optimize): genes are Tune(...)
+    markers in the config tree; fitness is the best validation error
+    count of a full (short) training run."""
+    from veles_tpu.config import root
+    from veles_tpu.genetics import (GeneticOptimizer, find_tunes,
+                                    substitute_tunes)
+
+    tunes = find_tunes(root)
+    if not tunes:
+        print("--optimize: no Tune(...) markers in the config tree",
+              file=sys.stderr)
+        return 2
+    pop_s, _, gen_s = args.optimize.partition(":")
+    pop, gen = int(pop_s), int(gen_s or 3)
+
+    def evaluate(values):
+        substitute_tunes(root, values)
+        launcher = Launcher(backend=args.backend, seed=args.seed,
+                            verbose=args.verbose)
+        mod = load_workflow_module(workflow_file)
+        if hasattr(mod, "run"):
+            mod.run(launcher)
+        elif hasattr(mod, "create_workflow"):
+            launcher.create_workflow(getattr(mod, "create_workflow"))
+            launcher.initialize()
+            launcher.run()
+        else:
+            raise RuntimeError(
+                f"{workflow_file}: defines neither run(launcher) nor "
+                "create_workflow(launcher)")
+        d = launcher.workflow.decision
+        err = d.min_valid_error
+        if err == float("inf"):
+            err = d.min_train_error
+        return err
+
+    opt = GeneticOptimizer(evaluate, tunes, population=pop,
+                           generations=gen)
+    best, fitness = opt.run()
+    import json
+    import math
+    if not math.isfinite(fitness):
+        print("--optimize: every evaluation failed (fitness inf); "
+              "check the workflow runs standalone first",
+              file=sys.stderr)
+        return 1
+    print(json.dumps({"best": best, "fitness": fitness}))
     return 0
 
 

@@ -1,0 +1,12 @@
+"""Ensemble training and prediction.
+
+Reference parity: veles/ensemble/ — train N instances of a workflow
+(different seeds), then aggregate member predictions (SURVEY.md §3.1
+Ensemble).  Members train sequentially in-process (one chip); their
+trained parameters are kept as host pytrees so prediction runs without
+keeping N live workflows.
+"""
+
+from veles_tpu.ensemble.core import EnsemblePredictor, EnsembleTrainer
+
+__all__ = ["EnsembleTrainer", "EnsemblePredictor"]

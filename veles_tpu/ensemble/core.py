@@ -1,0 +1,97 @@
+"""Ensemble core: sequential member training + averaged prediction."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.logger import Logger
+
+
+class EnsembleTrainer(Logger):
+    """Trains N members of ``workflow_factory() -> StandardWorkflow``
+    with per-member seeds; records each member's trained params and
+    validation error."""
+
+    def __init__(self, workflow_factory: Callable[[], Any],
+                 device_factory: Callable[[], Any],
+                 n_members: int = 4,
+                 base_seed: int = 1234) -> None:
+        self.workflow_factory = workflow_factory
+        self.device_factory = device_factory
+        self.n_members = n_members
+        self.base_seed = base_seed
+        #: [{"params": pytree, "valid_error": float, "seed": int}]
+        self.members: List[Dict[str, Any]] = []
+
+    def train(self) -> List[Dict[str, Any]]:
+        for i in range(self.n_members):
+            seed = self.base_seed + 7919 * i
+            prng.seed_all(seed)
+            w = self.workflow_factory()
+            w.initialize(device=self.device_factory())
+            w.run()
+            params = self._trained_params(w)
+            err = w.decision.epoch_error_pct[1]
+            self.members.append({"params": params, "valid_error": err,
+                                 "seed": seed,
+                                 "forward_names": [f.name
+                                                   for f in w.forwards]})
+            self.info("member %d/%d (seed %d): valid error %.2f%%",
+                      i + 1, self.n_members, seed, err)
+        return self.members
+
+    @staticmethod
+    def _trained_params(w) -> Dict[str, Dict[str, np.ndarray]]:
+        out = {}
+        if getattr(w, "fused", None) is not None and \
+                w.fused._params is not None:
+            w.fused.sync_params_to_vectors()
+        for f in w.forwards:
+            p = {}
+            if f.weights:
+                p["weights"] = np.asarray(f.weights.map_read()).copy()
+            if f.bias and f.include_bias:
+                p["bias"] = np.asarray(f.bias.map_read()).copy()
+            out[f.name] = p
+        return out
+
+
+class EnsemblePredictor(Logger):
+    """Averages member class-probability outputs (the reference's
+    aggregation mode for classifiers)."""
+
+    def __init__(self, workflow_factory: Callable[[], Any],
+                 device_factory: Callable[[], Any],
+                 members: List[Dict[str, Any]]) -> None:
+        if not members:
+            raise ValueError("empty ensemble")
+        self.members = members
+        # ONE template workflow provides the pure forward chain; member
+        # params are swapped through it
+        prng.seed_all(members[0]["seed"])
+        self.workflow = workflow_factory()
+        self.workflow.initialize(device=device_factory())
+        self._forwards = list(self.workflow.forwards)
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        """Mean of member probability outputs for a batch (NHWC/ND)."""
+        acc: Optional[np.ndarray] = None
+        for m in self.members:
+            out = x
+            for f in self._forwards:
+                p = {k: np.asarray(v)
+                     for k, v in m["params"][f.name].items()}
+                out, _ = f.apply_fwd(p, out, rng=None, train=False)
+            out = np.asarray(out)
+            acc = out if acc is None else acc + out
+        return acc / len(self.members)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.argmax(self.predict_proba(x), axis=-1)
+
+    def error_pct(self, x: np.ndarray, labels: np.ndarray) -> float:
+        pred = self.predict(x)
+        return 100.0 * float((pred != labels).mean())

@@ -1,0 +1,22 @@
+"""Genetic-algorithm hyperparameter tuning.
+
+Reference parity: veles/genetics/ — config values wrapped in
+``Tune(...)`` become GA genes; the optimizer spawns many workflow runs
+and selects by fitness (validation error) (SURVEY.md §3.1 Genetics).
+
+TPU adaptation: evaluations run in-process sequentially (one chip, jit
+caches warm between runs) instead of forked worker processes; the GA
+itself (tournament selection, blend crossover, gaussian mutation,
+elitism) is deterministic through a named PRNG stream.
+
+Usage::
+
+    root.mnist.layers[0]["<-"]["learning_rate"] = Tune(0.1, 0.01, 1.0)
+    ...
+    python -m veles_tpu --optimize 8:5 workflow.py config.py
+"""
+
+from veles_tpu.genetics.core import (GeneticOptimizer, Tune, find_tunes,
+                                     substitute_tunes)
+
+__all__ = ["Tune", "GeneticOptimizer", "find_tunes", "substitute_tunes"]

@@ -1,0 +1,204 @@
+"""GA core: Tune markers, tree walking, the optimizer loop."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.config import Config
+from veles_tpu.logger import Logger
+
+
+class Tune:
+    """Marks a config value as a GA gene: ``Tune(default, min, max)``.
+    Integer defaults breed integers (e.g. layer widths), float defaults
+    breed floats (e.g. learning rates, log-uniform when min > 0)."""
+
+    def __init__(self, value: Any, minv: float, maxv: float) -> None:
+        if minv > maxv:
+            raise ValueError(f"Tune: min {minv} > max {maxv}")
+        self.value = value
+        self.minv = minv
+        self.maxv = maxv
+        self.is_int = isinstance(value, (int, np.integer)) and \
+            not isinstance(value, bool)
+        #: log-scale breeding for positive float ranges spanning >=10x
+        self.log_scale = (not self.is_int and minv > 0
+                          and maxv / minv >= 10.0)
+
+    def clip(self, x: float) -> Any:
+        x = float(np.clip(x, self.minv, self.maxv))
+        return int(round(x)) if self.is_int else x
+
+    def __repr__(self) -> str:
+        return f"Tune({self.value}, {self.minv}, {self.maxv})"
+
+
+def _walk(obj: Any, path: str, out: Dict[str, Tune]) -> None:
+    if isinstance(obj, Tune):
+        out[path] = obj
+    elif isinstance(obj, Config):
+        for k, v in obj.__dict__.items():
+            _walk(v, f"{path}.{k}" if path else k, out)
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _walk(v, f"{path}[{k!r}]", out)
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _walk(v, f"{path}[{i}]", out)
+
+
+def find_tunes(tree: Any) -> Dict[str, Tune]:
+    """All Tune markers under a Config tree / dict / list, keyed by a
+    path expression usable with substitute_tunes."""
+    out: Dict[str, Tune] = {}
+    _walk(tree, "", out)
+    return out
+
+
+def _set_path(tree: Any, path: str, value: Any) -> None:
+    # path grammar produced by _walk: dotted attrs + [key] indexers
+    import re
+
+    tokens = re.findall(r"[A-Za-z_][A-Za-z_0-9]*|\[[^\]]+\]", path)
+    cur = tree
+    for i, tok in enumerate(tokens):
+        last = i == len(tokens) - 1
+        if tok.startswith("["):
+            key = eval(tok[1:-1])  # noqa: S307 — our own repr'd keys
+            if last:
+                cur[key] = value
+            else:
+                cur = cur[key]
+        else:
+            if last:
+                setattr(cur, tok, value)
+            else:
+                cur = getattr(cur, tok)
+
+
+def substitute_tunes(tree: Any, values: Dict[str, Any]) -> None:
+    """Replace each Tune marker with a concrete value, in place."""
+    for path, v in values.items():
+        _set_path(tree, path, v)
+
+
+class GeneticOptimizer(Logger):
+    """Tournament-select / blend-crossover / gaussian-mutate GA.
+
+    ``evaluate(values: {path: value}) -> fitness`` — LOWER is better
+    (validation error).  Failed evaluations (exceptions) score inf and
+    are selected against instead of aborting the run.
+    """
+
+    def __init__(self, evaluate: Callable[[Dict[str, Any]], float],
+                 tunes: Dict[str, Tune],
+                 population: int = 8,
+                 generations: int = 5,
+                 elite: int = 2,
+                 mutation_rate: float = 0.25,
+                 mutation_sigma: float = 0.15,
+                 rng_stream: str = "genetics") -> None:
+        if not tunes:
+            raise ValueError("no Tune(...) markers found to optimize")
+        self.evaluate = evaluate
+        self.tunes = tunes
+        self.paths = sorted(tunes)
+        self.population = max(population, 2 + elite)
+        self.generations = generations
+        self.elite = elite
+        self.mutation_rate = mutation_rate
+        self.mutation_sigma = mutation_sigma
+        self.rng = prng.get(rng_stream).numpy
+        #: [(fitness, values)] per generation, best first
+        self.history: List[List[Tuple[float, Dict[str, Any]]]] = []
+
+    # -- genome <-> values --------------------------------------------
+
+    def _to_gene(self, t: Tune, x: float) -> float:
+        return float(np.log(x)) if t.log_scale else float(x)
+
+    def _from_gene(self, t: Tune, g: float) -> Any:
+        return t.clip(np.exp(g) if t.log_scale else g)
+
+    def _bounds(self, t: Tune) -> Tuple[float, float]:
+        if t.log_scale:
+            return float(np.log(t.minv)), float(np.log(t.maxv))
+        return float(t.minv), float(t.maxv)
+
+    def _decode(self, genome: np.ndarray) -> Dict[str, Any]:
+        return {p: self._from_gene(self.tunes[p], g)
+                for p, g in zip(self.paths, genome)}
+
+    # -- GA operators --------------------------------------------------
+
+    def _initial_population(self) -> np.ndarray:
+        pop = []
+        # individual 0 = the config's own defaults
+        pop.append([self._to_gene(self.tunes[p], self.tunes[p].value)
+                    for p in self.paths])
+        for _ in range(self.population - 1):
+            genome = []
+            for p in self.paths:
+                lo, hi = self._bounds(self.tunes[p])
+                genome.append(self.rng.uniform(lo, hi))
+            pop.append(genome)
+        return np.asarray(pop, np.float64)
+
+    def _tournament(self, fits: np.ndarray, k: int = 2) -> int:
+        picks = self.rng.choice(len(fits), size=k, replace=False)
+        return int(picks[np.argmin(fits[picks])])
+
+    def _crossover(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        alpha = self.rng.uniform(-0.25, 1.25, size=a.shape)  # BLX-like
+        return a + alpha * (b - a)
+
+    def _mutate(self, g: np.ndarray) -> np.ndarray:
+        g = g.copy()
+        for i, p in enumerate(self.paths):
+            if self.rng.random() < self.mutation_rate:
+                lo, hi = self._bounds(self.tunes[p])
+                g[i] += self.rng.normal(0.0, self.mutation_sigma) \
+                    * (hi - lo)
+            lo, hi = self._bounds(self.tunes[p])
+            g[i] = np.clip(g[i], lo, hi)
+        return g
+
+    # -- the loop ------------------------------------------------------
+
+    def _fitness(self, genome: np.ndarray) -> float:
+        values = self._decode(genome)
+        try:
+            return float(self.evaluate(values))
+        except Exception as e:  # noqa: BLE001 — GA must survive bad genes
+            self.warning("evaluation failed for %s: %s", values, e)
+            return float("inf")
+
+    def run(self) -> Tuple[Dict[str, Any], float]:
+        pop = self._initial_population()
+        fits = np.array([self._fitness(g) for g in pop])
+        for gen in range(self.generations):
+            order = np.argsort(fits)
+            pop, fits = pop[order], fits[order]
+            self.history.append([(float(f), self._decode(g))
+                                 for f, g in zip(fits, pop)])
+            self.info("generation %d: best=%.4f %s", gen, fits[0],
+                      self._decode(pop[0]))
+            nxt = list(pop[:self.elite])
+            while len(nxt) < self.population:
+                a = pop[self._tournament(fits)]
+                b = pop[self._tournament(fits)]
+                child = self._mutate(self._crossover(a, b))
+                nxt.append(child)
+            new = np.asarray(nxt)
+            new_fits = np.concatenate([
+                fits[:self.elite],
+                [self._fitness(g) for g in new[self.elite:]]])
+            pop, fits = new, new_fits
+        order = np.argsort(fits)
+        best = self._decode(pop[order[0]])
+        self.info("GA done: best fitness %.4f with %s",
+                  fits[order[0]], best)
+        return best, float(fits[order[0]])

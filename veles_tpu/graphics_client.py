@@ -11,10 +11,9 @@ training runs headless:
 
 from __future__ import annotations
 
-import pickle
 import sys
 
-from veles_tpu.graphics_server import FileRenderer
+from veles_tpu.graphics_server import FileRenderer, decode_event
 from veles_tpu.logger import Logger, setup_logging
 
 
@@ -34,7 +33,7 @@ class GraphicsClient(Logger):
         n = 0
         try:
             while True:
-                event = pickle.loads(sock.recv())
+                event = decode_event(sock.recv())
                 path = self.renderer.render(event)
                 if path:
                     self.info("rendered %s", path)

@@ -13,11 +13,40 @@ exactly like the reference's GUI client.
 
 from __future__ import annotations
 
+import json
 import os
-import pickle
 from typing import Any, Dict, List, Optional
 
 from veles_tpu.logger import Logger
+
+
+def _jsonable(obj: Any) -> Any:
+    """numpy arrays/scalars -> plain lists/numbers.  The wire format is
+    JSON, NOT pickle: plot events cross trust boundaries (a viewer
+    subscribing to a remote training host must not execute whatever the
+    host — or whoever spoofs it — sends)."""
+    import numpy as np
+
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    return obj
+
+
+def encode_event(event: Dict[str, Any]) -> bytes:
+    return json.dumps(_jsonable(event)).encode()
+
+
+def decode_event(raw: bytes) -> Dict[str, Any]:
+    event = json.loads(raw)
+    if not isinstance(event, dict):
+        raise ValueError("plot event must be a JSON object")
+    return event
 
 _server: Optional["GraphicsServer"] = None
 
@@ -67,7 +96,7 @@ class GraphicsServer(Logger):
         """event: {"plotter": name, "kind": ..., payload...}."""
         sock = self._ensure_sock()
         if sock is not None:
-            sock.send(pickle.dumps(event, protocol=4))
+            sock.send(encode_event(event))
         if self.render:
             if self._renderer is None:
                 self._renderer = FileRenderer(self.out_dir)

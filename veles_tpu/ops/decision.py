@@ -59,6 +59,11 @@ class DecisionGD(Unit, Distributable):
         #: matrix is per-class-per-epoch, not a run-cumulative blur
         self.confusion_per_class: List[Any] = [None, None, None]
 
+    def __setstate__(self, state: dict) -> None:
+        super().__setstate__(state)
+        # keep snapshots from before this attr existed resumable
+        self.__dict__.setdefault("confusion_per_class", [None, None, None])
+
     # -- metric intake -------------------------------------------------
 
     def accumulate(self, n_err: Any, loss_sum: Any, count: Any) -> None:

@@ -254,6 +254,13 @@ class StandardWorkflow(NNWorkflow):
 
     # -- lifecycle -----------------------------------------------------
 
+    def __setstate__(self, state: dict) -> None:
+        super().__setstate__(state)
+        # attrs introduced after a snapshot was written must default,
+        # or pre-existing snapshots become unresumable
+        self.__dict__.setdefault("_extra_after_decision", [])
+        self.__dict__.setdefault("plotters", [])
+
     def initialize(self, device: Optional[Device] = None, **kwargs) -> None:
         use_fused = device is not None and device.is_jax \
             and kwargs.pop("fused", True)

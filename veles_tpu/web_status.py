@@ -20,8 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from veles_tpu.logger import Logger
-from veles_tpu.mutable import Bool
-from veles_tpu.units import Unit
+from veles_tpu.plotting_units import Plotter
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>veles_tpu status</title>
@@ -73,6 +72,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:
+        import html
+
         runs = self.store.snapshot()
         if self.path.startswith("/api/status"):
             self._send(200, json.dumps(runs).encode(),
@@ -80,16 +81,21 @@ class _Handler(BaseHTTPRequestHandler):
             return
         now = time.time()
         rows = []
+
+        def esc(v) -> str:
+            # /api/update is open to the network — escape EVERYTHING
+            return html.escape(str(v), quote=True)
+
         for rid, r in sorted(runs.items()):
             age = now - r.get("updated_at", 0)
             cls = ' class="stale"' if age > 30 else ""
             rows.append(
-                f"<tr{cls}><td>{r.get('name', rid)}</td>"
-                f"<td>{r.get('mode', '?')}</td>"
-                f"<td>{r.get('epoch', '?')}</td>"
-                f"<td>{r.get('train_error_pct', '')}</td>"
-                f"<td>{r.get('valid_error_pct', '')}</td>"
-                f"<td>{r.get('min_valid_error', '')}</td>"
+                f"<tr{cls}><td>{esc(r.get('name', rid))}</td>"
+                f"<td>{esc(r.get('mode', '?'))}</td>"
+                f"<td>{esc(r.get('epoch', '?'))}</td>"
+                f"<td>{esc(r.get('train_error_pct', ''))}</td>"
+                f"<td>{esc(r.get('valid_error_pct', ''))}</td>"
+                f"<td>{esc(r.get('min_valid_error', ''))}</td>"
                 f"<td>{int(age)}s ago</td></tr>")
         self._send(200, _PAGE.format(rows="\n".join(rows)).encode())
 
@@ -100,9 +106,11 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         try:
             data = json.loads(self.rfile.read(length))
-            self.store.update(data["id"], data)
+            if not isinstance(data, dict):
+                raise ValueError("update must be a JSON object")
+            self.store.update(str(data["id"]), data)
             self._send(200, b'{"ok": true}', "application/json")
-        except (ValueError, KeyError) as e:
+        except (ValueError, KeyError, TypeError) as e:
             self._send(400, json.dumps({"error": str(e)}).encode(),
                        "application/json")
 
@@ -129,9 +137,10 @@ class WebStatusServer(Logger):
         self.httpd.server_close()
 
 
-class StatusReporter(Unit):
-    """Fires after Decision once per epoch; POSTs workflow status to a
-    web-status server (reference: workflows POST periodic updates)."""
+class StatusReporter(Plotter):
+    """Fires after Decision once per epoch (the Plotter gate); POSTs
+    workflow status to a web-status server (reference: workflows POST
+    periodic updates)."""
 
     def __init__(self, workflow=None, url: str = "",
                  mode: str = "standalone", **kwargs: Any) -> None:
@@ -139,13 +148,7 @@ class StatusReporter(Unit):
         self.url = url.rstrip("/")
         self.mode = mode
         self.run_id = f"{workflow.name if workflow else 'run'}-{id(self):x}"
-        self.decision = None
         self.failures = 0
-
-    def link_decision(self, decision) -> None:
-        self.decision = decision
-        self.gate_skip = Bool.from_expr(
-            lambda d=decision: not bool(d.epoch_ended_flag))
 
     def payload(self) -> Dict[str, Any]:
         d = self.decision
