@@ -1,0 +1,249 @@
+"""Forge packaging (veles_tpu/forge.py) and image-file loaders
+(veles_tpu/loader/image.py) — SURVEY.md §3.1 Forge client / Image
+loaders."""
+
+import io
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.forge import ForgePackage
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.loader.image import (FileListImageLoader,
+                                    ImageDirectoryLoader, decode_image)
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+
+def write_png(path, arr):
+    from PIL import Image
+
+    Image.fromarray(arr.astype(np.uint8)).save(path)
+
+
+@pytest.fixture
+def image_tree(tmp_path):
+    """train/validation trees with 2 classes of tiny distinct images."""
+    rng = np.random.default_rng(7)
+    for split, n in (("train", 12), ("validation", 6)):
+        for ci, cls in enumerate(["circles", "squares"]):
+            d = tmp_path / split / cls
+            d.mkdir(parents=True)
+            for i in range(n):
+                img = np.full((10, 12, 3), 40 + 150 * ci, np.uint8)
+                img += rng.integers(0, 40, img.shape, dtype=np.uint8)
+                write_png(d / f"img{i}.png", img)
+    return tmp_path
+
+
+class TestDecodeImage:
+    def test_resize_and_gray(self, tmp_path):
+        p = tmp_path / "x.png"
+        write_png(p, np.full((8, 8, 3), 128, np.uint8))
+        a = decode_image(str(p), (4, 6, 1))
+        assert a.shape == (4, 6, 1)
+        assert a.dtype == np.float32
+        assert 0.45 < a.mean() < 0.55  # normalized
+
+    def test_rgb(self, tmp_path):
+        p = tmp_path / "x.png"
+        write_png(p, np.full((5, 5, 3), 255, np.uint8))
+        a = decode_image(str(p), (5, 5, 3))
+        assert a.shape == (5, 5, 3)
+        np.testing.assert_allclose(a, 1.0)
+
+
+class TestImageDirectoryLoader:
+    def test_loads_tree(self, image_tree):
+        ld = ImageDirectoryLoader(
+            data_dir=str(image_tree), target_shape=(10, 12, 3),
+            minibatch_size=8, name="imgloader")
+        ld.initialize(device=None)
+        assert ld.class_names == ["circles", "squares"]
+        assert ld.class_lengths == [0, 12, 24]
+        assert ld.original_data.mem.shape == (36, 10, 12, 3)
+        # labels match pixel intensity classes
+        labels = ld.original_labels.mem
+        dark = ld.original_data.mem[labels == 0].mean()
+        bright = ld.original_data.mem[labels == 1].mean()
+        assert dark < bright
+
+    def test_empty_tree_raises(self, tmp_path):
+        ld = ImageDirectoryLoader(data_dir=str(tmp_path),
+                                  name="imgloader")
+        with pytest.raises(ValueError, match="no class directories"):
+            ld.load_data()
+
+    def test_trains_workflow(self, image_tree):
+        prng.seed_all(777)
+        w = StandardWorkflow(
+            loader_factory=lambda wf: ImageDirectoryLoader(
+                wf, data_dir=str(image_tree), target_shape=(10, 12, 3),
+                minibatch_size=12, name="loader",
+                normalization_type="mean_disp"),
+            layers=[{"type": "softmax",
+                     "->": {"output_sample_shape": 2},
+                     "<-": {"learning_rate": 0.05}}],
+            decision_config={"max_epochs": 10}, name="img_wf")
+        w.initialize(device=NumpyDevice())
+        w.run()
+        # trivial brightness classes must be fully separable
+        assert w.decision.epoch_error_pct[1] == 0.0, \
+            w.decision.epoch_error_pct
+
+    def test_snapshot_drops_pixels(self, image_tree):
+        import pickle
+        ld = ImageDirectoryLoader(
+            data_dir=str(image_tree), target_shape=(10, 12, 3),
+            minibatch_size=8, name="imgloader")
+        ld.initialize(device=None)
+        blob = pickle.dumps(ld)
+        assert len(blob) < 20000, len(blob)
+        ld2 = pickle.loads(blob)
+        ld2.initialize(device=None)  # re-decodes from disk
+        np.testing.assert_array_equal(ld2.original_labels.mem,
+                                      ld.original_labels.mem)
+
+
+class TestFileListLoader:
+    def test_explicit_lists(self, image_tree):
+        paths0 = sorted((image_tree / "train" / "circles").iterdir())
+        paths1 = sorted((image_tree / "train" / "squares").iterdir())
+        train = [(str(p), 0) for p in paths0[:8]] + \
+                [(str(p), 1) for p in paths1[:8]]
+        valid = [(str(p), 0) for p in paths0[8:]] + \
+                [(str(p), 1) for p in paths1[8:]]
+        ld = FileListImageLoader(train=train, valid=valid,
+                                 target_shape=(10, 12, 3),
+                                 minibatch_size=8, name="fl")
+        ld.initialize(device=None)
+        assert ld.class_lengths == [0, 8, 16]
+
+
+class TestLoaderNormalization:
+    def test_mean_disp_fit_on_train_only(self):
+        from veles_tpu.loader import ArrayLoader
+        x_tr = np.random.default_rng(0).normal(5.0, 2.0,
+                                               (100, 4)).astype(np.float32)
+        x_va = np.random.default_rng(1).normal(9.0, 2.0,
+                                               (40, 4)).astype(np.float32)
+        y_tr = np.zeros(100, np.int64)
+        y_va = np.zeros(40, np.int64)
+        ld = ArrayLoader(train=(x_tr, y_tr), valid=(x_va, y_va),
+                         minibatch_size=20, name="n",
+                         normalization_type="mean_disp")
+        ld.initialize(device=None)
+        data = ld.original_data.mem
+        train_rows = data[ld.class_offset(2):]
+        valid_rows = data[:40]
+        # train standardized exactly; valid shifted by the TRAIN stats
+        np.testing.assert_allclose(train_rows.mean(0), 0.0, atol=1e-4)
+        assert valid_rows.mean() > 1.0  # (9-5)/2 = 2-ish
+
+    def test_normalizer_state_survives_snapshot(self):
+        import pickle
+        from veles_tpu.loader.synthetic import \
+            SyntheticClassificationLoader
+        ld = SyntheticClassificationLoader(
+            n_train=50, n_valid=20, shape=(4, 4, 1), n_classes=2,
+            minibatch_size=10, name="n",
+            normalization_type="mean_disp")
+        ld.initialize(device=None)
+        normed = ld.original_data.mem.copy()
+        mean0 = ld.normalizer.mean.copy()
+        ld2 = pickle.loads(pickle.dumps(ld))
+        ld2.initialize(device=None)  # regenerates + re-applies stats
+        np.testing.assert_array_equal(ld2.normalizer.mean, mean0)
+        np.testing.assert_allclose(ld2.original_data.mem, normed,
+                                   atol=1e-6)
+
+
+class TestForge:
+    @pytest.fixture
+    def pkg(self, tmp_path):
+        wf = tmp_path / "wf.py"
+        wf.write_text("def run(launcher):\n    pass\n")
+        cfg = tmp_path / "cfg.py"
+        cfg.write_text("root.x = 1\n")
+        snap = tmp_path / "snap.pkl.gz"
+        snap.write_bytes(b"\x1f\x8b" + b"0" * 100)
+        out = str(tmp_path / "model.vpkg")
+        ForgePackage.pack(out, "mnist-demo", str(wf), [str(cfg)],
+                          snapshot=str(snap), version="2.1.0",
+                          author="me", description="demo net")
+        return out, tmp_path
+
+    def test_pack_and_manifest(self, pkg):
+        out, _ = pkg
+        m = ForgePackage.read_manifest(out)
+        assert m["name"] == "mnist-demo"
+        assert m["entry"] == "wf.py"
+        assert m["configs"] == ["cfg.py"]
+        assert m["snapshot"] == "snap.pkl.gz"
+        assert set(m["sha256"]) == {"wf.py", "cfg.py", "snap.pkl.gz"}
+
+    def test_install_verifies_and_extracts(self, pkg, tmp_path):
+        out, _ = pkg
+        dest = tmp_path / "store"
+        m = ForgePackage.install(out, str(dest))
+        root = m["root"]
+        assert root.endswith("mnist-demo-2.1.0")
+        assert os.path.isfile(os.path.join(root, "wf.py"))
+        assert os.path.isfile(os.path.join(root, "snap.pkl.gz"))
+
+    def test_install_detects_corruption(self, pkg, tmp_path):
+        out, src = pkg
+        # corrupt a member but keep the manifest hashes
+        with tarfile.open(out, "r:gz") as tar:
+            members = {m.name: tar.extractfile(m).read()
+                       if m.isfile() else None
+                       for m in tar.getmembers()}
+        members["cfg.py"] = b"root.x = 666  # tampered\n"
+        bad = str(src / "bad.vpkg")
+        with tarfile.open(bad, "w:gz") as tar:
+            for name, data in members.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            ForgePackage.install(bad, str(tmp_path / "store2"))
+
+    def test_install_rejects_traversal(self, tmp_path):
+        evil = str(tmp_path / "evil.vpkg")
+        manifest = json.dumps({"format_version": 1, "name": "e",
+                               "version": "1", "sha256": {}}).encode()
+        with tarfile.open(evil, "w:gz") as tar:
+            info = tarfile.TarInfo("manifest.json")
+            info.size = len(manifest)
+            tar.addfile(info, io.BytesIO(manifest))
+            info = tarfile.TarInfo("../../escape.txt")
+            info.size = 3
+            tar.addfile(info, io.BytesIO(b"pwn"))
+        with pytest.raises(ValueError, match="unsafe member"):
+            ForgePackage.install(evil, str(tmp_path / "store3"))
+
+    def test_list_store(self, pkg, tmp_path):
+        out, _ = pkg
+        store = tmp_path / "thestore"
+        store.mkdir()
+        import shutil
+        shutil.copy(out, store / "model.vpkg")
+        (store / "junk.vpkg").write_bytes(b"not a tar")
+        items = ForgePackage.list_store(str(store))
+        assert len(items) == 1
+        assert items[0]["name"] == "mnist-demo"
+
+    def test_rejects_future_format(self, tmp_path):
+        fut = str(tmp_path / "fut.vpkg")
+        manifest = json.dumps({"format_version": 99, "name": "f",
+                               "version": "1", "sha256": {}}).encode()
+        with tarfile.open(fut, "w:gz") as tar:
+            info = tarfile.TarInfo("manifest.json")
+            info.size = len(manifest)
+            tar.addfile(info, io.BytesIO(manifest))
+        with pytest.raises(ValueError, match="newer"):
+            ForgePackage.read_manifest(fut)

@@ -1,0 +1,202 @@
+"""Forge: package, inspect, and install trained workflows.
+
+Reference parity: veles/forge_client.py — package a workflow (manifest
++ code + snapshot) and publish it to the VelesForge marketplace
+(SURVEY.md §3.1 "Forge client").  This environment has no network, so
+the "marketplace" is a local/shared directory of packages; the archive
+format is the deliverable (it also feeds the native inference runtime,
+libveles-equivalent).
+
+Package layout (.tar.gz):
+
+    manifest.json     name, version, author, entry, files, sha256 map
+    workflow.py       the workflow module
+    *.py              config files
+    snapshot.pkl.gz   trained state (optional but usual)
+
+CLI:
+
+    python -m veles_tpu.forge pack  out.vpkg --name X workflow.py \
+        [config.py ...] [--snapshot snap.pkl.gz]
+    python -m veles_tpu.forge info    pkg.vpkg
+    python -m veles_tpu.forge install pkg.vpkg [dest_dir]
+    python -m veles_tpu.forge list    [store_dir]
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import tarfile
+import time
+from typing import Any, Dict, List, Optional
+
+from veles_tpu.logger import Logger
+
+MANIFEST = "manifest.json"
+FORMAT_VERSION = 1
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class ForgePackage(Logger):
+    @staticmethod
+    def pack(out_path: str, name: str, workflow_file: str,
+             config_files: Optional[List[str]] = None,
+             snapshot: Optional[str] = None,
+             version: str = "1.0.0", author: str = "",
+             description: str = "") -> str:
+        files = [workflow_file] + list(config_files or [])
+        if snapshot:
+            files.append(snapshot)
+        for f in files:
+            if not os.path.isfile(f):
+                raise FileNotFoundError(f)
+        arcnames = {}
+        seen = set()
+        for f in files:
+            base = os.path.basename(f)
+            if base in seen:
+                raise ValueError(f"duplicate file name in package: "
+                                 f"{base}")
+            seen.add(base)
+            arcnames[f] = base
+        manifest = {
+            "format_version": FORMAT_VERSION,
+            "name": name,
+            "version": version,
+            "author": author,
+            "description": description,
+            "created": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime()),
+            "entry": os.path.basename(workflow_file),
+            "configs": [os.path.basename(c)
+                        for c in (config_files or [])],
+            "snapshot": os.path.basename(snapshot) if snapshot else None,
+            "sha256": {arcnames[f]: _sha256(f) for f in files},
+        }
+        blob = json.dumps(manifest, indent=2).encode()
+        with tarfile.open(out_path, "w:gz") as tar:
+            info = tarfile.TarInfo(MANIFEST)
+            info.size = len(blob)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(blob))
+            for f in files:
+                tar.add(f, arcname=arcnames[f])
+        return out_path
+
+    @staticmethod
+    def read_manifest(pkg_path: str) -> Dict[str, Any]:
+        with tarfile.open(pkg_path, "r:gz") as tar:
+            member = tar.getmember(MANIFEST)
+            manifest = json.loads(tar.extractfile(member).read())
+        if manifest.get("format_version", 0) > FORMAT_VERSION:
+            raise ValueError(
+                f"package format {manifest['format_version']} is newer "
+                f"than this framework understands ({FORMAT_VERSION})")
+        return manifest
+
+    @staticmethod
+    def install(pkg_path: str, dest_dir: str,
+                verify: bool = True) -> Dict[str, Any]:
+        """Extract + checksum-verify; returns the manifest with an
+        added 'root' key pointing at the extracted directory."""
+        manifest = ForgePackage.read_manifest(pkg_path)
+        target = os.path.join(dest_dir,
+                              f"{manifest['name']}-{manifest['version']}")
+        os.makedirs(target, exist_ok=True)
+        with tarfile.open(pkg_path, "r:gz") as tar:
+            for member in tar.getmembers():
+                # refuse path traversal — packages may come from anyone
+                mpath = os.path.normpath(member.name)
+                if mpath.startswith("..") or os.path.isabs(mpath) \
+                        or not (member.isfile() or member.isdir()):
+                    raise ValueError(
+                        f"unsafe member in package: {member.name!r}")
+            tar.extractall(target, filter="data")
+        if verify:
+            for fname, want in manifest["sha256"].items():
+                got = _sha256(os.path.join(target, fname))
+                if got != want:
+                    raise ValueError(
+                        f"checksum mismatch for {fname}: "
+                        f"{got[:12]} != {want[:12]}")
+        manifest["root"] = target
+        return manifest
+
+    @staticmethod
+    def list_store(store_dir: str) -> List[Dict[str, Any]]:
+        out = []
+        if not os.path.isdir(store_dir):
+            return out
+        for fn in sorted(os.listdir(store_dir)):
+            if fn.endswith((".vpkg", ".tar.gz")):
+                try:
+                    m = ForgePackage.read_manifest(
+                        os.path.join(store_dir, fn))
+                    m["file"] = fn
+                    out.append(m)
+                except (tarfile.TarError, KeyError, ValueError):
+                    continue
+        return out
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    from veles_tpu.logger import setup_logging
+
+    setup_logging()
+    p = argparse.ArgumentParser(prog="veles_tpu.forge",
+                                description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pk = sub.add_parser("pack")
+    pk.add_argument("out")
+    pk.add_argument("workflow")
+    pk.add_argument("configs", nargs="*")
+    pk.add_argument("--name", required=True)
+    pk.add_argument("--version", default="1.0.0")
+    pk.add_argument("--author", default="")
+    pk.add_argument("--description", default="")
+    pk.add_argument("--snapshot", default=None)
+    pi = sub.add_parser("info")
+    pi.add_argument("pkg")
+    ins = sub.add_parser("install")
+    ins.add_argument("pkg")
+    ins.add_argument("dest", nargs="?", default="forge_store")
+    ls = sub.add_parser("list")
+    ls.add_argument("store", nargs="?", default="forge_store")
+    args = p.parse_args(argv)
+
+    if args.cmd == "pack":
+        path = ForgePackage.pack(
+            args.out, args.name, args.workflow, args.configs,
+            snapshot=args.snapshot, version=args.version,
+            author=args.author, description=args.description)
+        print(path)
+    elif args.cmd == "info":
+        print(json.dumps(ForgePackage.read_manifest(args.pkg),
+                         indent=2))
+    elif args.cmd == "install":
+        m = ForgePackage.install(args.pkg, args.dest)
+        print(m["root"])
+    elif args.cmd == "list":
+        for m in ForgePackage.list_store(args.store):
+            print(f"{m['file']}: {m['name']} {m['version']} "
+                  f"({m.get('description', '')})")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -83,6 +83,9 @@ class Loader(Unit, Distributable):
         path).  Subclasses may skip when the fused device path is on."""
         raise NotImplementedError
 
+    def post_load_data(self) -> None:
+        """Hook after load_data (FullBatchLoader normalizes here)."""
+
     # -- helpers -------------------------------------------------------
 
     @property
@@ -107,6 +110,7 @@ class Loader(Unit, Distributable):
         self.load_data()
         if not any(self.class_lengths):
             raise ValueError(f"{self.name}: load_data produced no samples")
+        self.post_load_data()
         self._present_classes = [c for c in (TEST, VALID, TRAIN)
                                  if self.class_lengths[c] > 0]
         # Snapshot resume: the pickled epoch order/cursor is mid-stream

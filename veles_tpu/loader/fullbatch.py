@@ -34,6 +34,14 @@ class FullBatchLoader(Loader):
         #: regression targets (MSE workflows) — may stay empty
         self.original_targets = Vector(name="original_targets")
         self.on_device = kwargs.get("on_device", True)
+        #: input normalization (reference: loaders own a Normalizer,
+        #: veles/normalization.py) — fitted on the TRAIN split once,
+        #: state rides in snapshots so resume does not refit
+        self.normalization_type = kwargs.get("normalization_type",
+                                             "none")
+        self.normalization_parameters = kwargs.get(
+            "normalization_parameters", {})
+        self.normalizer = None
 
     @property
     def has_labels(self) -> bool:
@@ -42,6 +50,22 @@ class FullBatchLoader(Loader):
     @property
     def has_targets(self) -> bool:
         return bool(self.original_targets)
+
+    def post_load_data(self) -> None:
+        if self.normalization_type == "none" and self.normalizer is None:
+            return
+        from veles_tpu.normalization import make_normalizer
+        from veles_tpu.loader.base import TRAIN
+        pre = self.original_data.mem
+        if self.normalizer is None:
+            self.normalizer = make_normalizer(
+                self.normalization_type, **self.normalization_parameters)
+            self.normalizer.fit(pre[self.class_offset(TRAIN):])
+        targets_alias_data = bool(self.original_targets) and \
+            self.original_targets.mem is pre
+        self.original_data.mem = self.normalizer.apply(pre)
+        if targets_alias_data:  # autoencoder: target = normalized input
+            self.original_targets.mem = self.original_data.mem
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)

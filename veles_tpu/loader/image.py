@@ -1,0 +1,139 @@
+"""Image-file loaders: directory trees and explicit file lists.
+
+Reference parity: veles/loader/image.py, file_image.py — datasets built
+from image files with scaling and color conversion (SURVEY.md §3.1
+"Image loaders").  Decoding uses PIL; arrays come out float32 NHWC in
+[0, 1], resized to a fixed ``target_shape`` (XLA needs static shapes).
+
+Layouts:
+
+- ``ImageDirectoryLoader``: ``root/<split>/<class_name>/img.png`` with
+  split dirs ``train``/``validation`` (or ``valid``)/``test``; class
+  names sorted -> label ids.
+- ``FileListImageLoader``: explicit ``[(path, label), ...]`` per split.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from veles_tpu.loader.base import TEST, TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+_SPLIT_DIRS = {TRAIN: ("train",), VALID: ("validation", "valid"),
+               TEST: ("test",)}
+_IMG_EXT = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".ppm", ".tif",
+            ".tiff", ".webp")
+
+
+def decode_image(path: str, target_shape: Tuple[int, int, int],
+                 normalize: bool = True) -> np.ndarray:
+    """path -> float32 HWC array resized to target_shape; grayscale or
+    RGB by the target's channel count."""
+    from PIL import Image
+
+    h, w, c = target_shape
+    with Image.open(path) as im:
+        im = im.convert("L" if c == 1 else "RGB")
+        if im.size != (w, h):
+            im = im.resize((w, h), Image.BILINEAR)
+        arr = np.asarray(im, np.float32)
+    if c == 1:
+        arr = arr[..., None]
+    if normalize:
+        arr /= 255.0
+    return arr
+
+
+class FileListImageLoader(FullBatchLoader):
+    """Loader over explicit per-split ``[(path, label), ...]`` lists."""
+
+    def __init__(self, workflow=None,
+                 train: Optional[Sequence[Tuple[str, int]]] = None,
+                 valid: Optional[Sequence[Tuple[str, int]]] = None,
+                 test: Optional[Sequence[Tuple[str, int]]] = None,
+                 target_shape: Tuple[int, int, int] = (32, 32, 3),
+                 normalize: bool = True,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.file_lists = {TRAIN: list(train or ()),
+                           VALID: list(valid or ()),
+                           TEST: list(test or ())}
+        self.target_shape = tuple(target_shape)
+        self.normalize = normalize
+
+    def load_data(self) -> None:
+        xs: List[np.ndarray] = []
+        ys: List[int] = []
+        for klass in (TEST, VALID, TRAIN):
+            entries = self.file_lists[klass]
+            self.class_lengths[klass] = len(entries)
+            for path, label in entries:
+                xs.append(decode_image(path, self.target_shape,
+                                       self.normalize))
+                ys.append(int(label))
+        if not xs:
+            raise ValueError(f"{self.name}: no image files")
+        self.original_data.mem = np.stack(xs)
+        self.original_labels.mem = np.asarray(ys, np.int32)
+
+    def __getstate__(self) -> dict:
+        # decoded pixels are regenerable from the file lists — drop the
+        # bulk like the synthetic loaders do (snapshots stay small)
+        d = super().__getstate__()
+        import copy
+        for key in ("original_data", "original_labels"):
+            vec = copy.copy(d[key])
+            vec.__setstate__({"name": vec.name, "mem": None})
+            d[key] = vec
+        return d
+
+
+class ImageDirectoryLoader(FileListImageLoader):
+    """Loader over ``root/<split>/<class>/image`` directory trees —
+    labels from sorted class-directory names."""
+
+    def __init__(self, workflow=None, data_dir: str = "",
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self.data_dir = data_dir
+        self.class_names: List[str] = []
+
+    def _split_dir(self, klass: int) -> Optional[str]:
+        for cand in _SPLIT_DIRS[klass]:
+            p = os.path.join(self.data_dir, cand)
+            if os.path.isdir(p):
+                return p
+        return None
+
+    def load_data(self) -> None:
+        names = set()
+        for klass in (TEST, VALID, TRAIN):
+            d = self._split_dir(klass)
+            if d:
+                names.update(e for e in os.listdir(d)
+                             if os.path.isdir(os.path.join(d, e)))
+        self.class_names = sorted(names)
+        if not self.class_names:
+            raise ValueError(
+                f"{self.name}: no class directories under "
+                f"{self.data_dir!r} (expected <split>/<class>/img)")
+        label_of: Dict[str, int] = {n: i for i, n
+                                    in enumerate(self.class_names)}
+        for klass in (TEST, VALID, TRAIN):
+            entries: List[Tuple[str, int]] = []
+            d = self._split_dir(klass)
+            if d:
+                for cls in sorted(os.listdir(d)):
+                    cdir = os.path.join(d, cls)
+                    if not os.path.isdir(cdir):
+                        continue
+                    for fn in sorted(os.listdir(cdir)):
+                        if fn.lower().endswith(_IMG_EXT):
+                            entries.append((os.path.join(cdir, fn),
+                                            label_of[cls]))
+            self.file_lists[klass] = entries
+        super().load_data()
