@@ -1,0 +1,48 @@
+/* libveles_tpu: native CPU inference runtime — C API.
+ *
+ * Reference parity: libVeles/libZnicz (the C++ deployment runtime that
+ * runs packaged trained workflows without Python; SURVEY.md §3.3).
+ * Models are exported by veles_tpu/export.py in the VTPN binary format
+ * and executed here with plain C++ (no Python, no JAX) — the
+ * "deploy-without-Python" capability, rebuilt for the TPU-era
+ * framework's NHWC/HWIO layouts.
+ */
+
+#ifndef VELES_C_H
+#define VELES_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct VelesModel VelesModel;
+
+/* Load a .vtpn model file.  On failure returns NULL and writes a
+ * message into err (if non-NULL). */
+VelesModel *veles_load(const char *path, char *err, int err_len);
+
+void veles_free(VelesModel *model);
+
+/* Per-sample input rank / dims (dims must hold >= rank entries). */
+int veles_input_rank(const VelesModel *model);
+void veles_input_dims(const VelesModel *model, int64_t *dims);
+
+/* Per-sample output element count (static across batches). */
+int64_t veles_output_size(const VelesModel *model);
+
+/* Number of ops in the network. */
+int veles_num_ops(const VelesModel *model);
+
+/* Run a forward pass on a batch of inputs (NHWC float32, contiguous).
+ * out must hold batch * veles_output_size() floats.
+ * Returns 0 on success, negative on error. */
+int veles_run(const VelesModel *model, const float *input, int batch,
+              float *out);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* VELES_C_H */

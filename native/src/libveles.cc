@@ -1,0 +1,541 @@
+// libveles_tpu: native CPU inference runtime (libVeles/libZnicz
+// equivalent, SURVEY.md §3.3).  Parses the VTPN model format written
+// by veles_tpu/export.py and executes the forward chain with plain
+// C++ — NHWC activations, HWIO conv weights, (n_in, n_out) dense
+// weights, matching veles_tpu/ops/*.py exactly (those are the test
+// oracle).
+//
+// Format VTPN v1 (little-endian):
+//   char magic[4] = "VTPN"; u32 version; u32 n_ops;
+//   i64 in_rank; i64 in_dims[in_rank];            // per-sample dims
+//   per op:
+//     u32 op_type; u32 activation;                // enums below
+//     u32 n_attr;   { u32 key; f64 value; } ...
+//     u32 n_tensor; { u32 id; u32 ndim; i64 dims[]; f32 data[] } ...
+
+#include "veles_c.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace {
+
+enum OpType {
+  OP_DENSE = 1,
+  OP_CONV = 2,
+  OP_MAXPOOL = 3,
+  OP_AVGPOOL = 4,
+  OP_LRN = 5,
+  OP_DROPOUT = 6,
+  OP_DECONV = 7,
+  OP_ACTIVATION = 8,
+  OP_STOCHPOOL_EVAL = 9,
+};
+
+enum Act {
+  ACT_LINEAR = 0,
+  ACT_TANH = 1,
+  ACT_RELU = 2,
+  ACT_SIGMOID = 3,
+  ACT_SOFTMAX = 4,
+  ACT_LOG = 5,
+};
+
+enum AttrKey {
+  A_KX = 0, A_KY = 1, A_SX = 2, A_SY = 3, A_PX = 4, A_PY = 5,
+  A_NKERN = 6, A_LRN_N = 7, A_ALPHA = 8, A_BETA = 9, A_K = 10,
+};
+
+enum TensorId { T_WEIGHTS = 0, T_BIAS = 1 };
+
+struct Tensor {
+  std::vector<int64_t> dims;
+  std::vector<float> data;
+  int64_t numel() const {
+    int64_t n = 1;
+    for (int64_t d : dims) n *= d;
+    return n;
+  }
+};
+
+struct Op {
+  uint32_t type = 0;
+  uint32_t act = ACT_LINEAR;
+  std::map<uint32_t, double> attr;
+  std::map<uint32_t, Tensor> tensors;
+
+  double a(uint32_t key, double dflt = 0.0) const {
+    auto it = attr.find(key);
+    return it == attr.end() ? dflt : it->second;
+  }
+  int ai(uint32_t key, int dflt = 0) const {
+    return static_cast<int>(a(key, dflt));
+  }
+  bool has(uint32_t id) const { return tensors.count(id) != 0; }
+};
+
+struct Shape {  // per-sample shape (no batch dim)
+  std::vector<int64_t> d;
+  int64_t numel() const {
+    int64_t n = 1;
+    for (int64_t x : d) n *= x;
+    return n;
+  }
+};
+
+}  // namespace
+
+struct VelesModel {
+  std::vector<Op> ops;
+  Shape in_shape;
+  Shape out_shape;           // derived at load
+  std::vector<Shape> shapes; // per-op OUTPUT sample shape
+};
+
+namespace {
+
+// ---------------------------------------------------------------- io
+
+struct Reader {
+  FILE *f;
+  bool ok = true;
+  explicit Reader(FILE *file) : f(file) {}
+  template <typename T>
+  T rd() {
+    T v{};
+    if (fread(&v, sizeof(T), 1, f) != 1) ok = false;
+    return v;
+  }
+  bool bytes(void *dst, size_t n) {
+    if (fread(dst, 1, n, f) != n) ok = false;
+    return ok;
+  }
+};
+
+void fail(char *err, int err_len, const char *msg) {
+  if (err && err_len > 0) {
+    snprintf(err, err_len, "%s", msg);
+  }
+}
+
+// --------------------------------------------------- shape inference
+
+int64_t conv_out(int64_t n, int k, int pad, int stride) {
+  return (n + 2 * pad - k) / stride + 1;
+}
+
+bool infer_shapes(VelesModel *m, std::string *why) {
+  Shape cur = m->in_shape;
+  for (const Op &op : m->ops) {
+    switch (op.type) {
+      case OP_DENSE: {
+        const Tensor &w = op.tensors.at(T_WEIGHTS);
+        if (cur.numel() != w.dims[0]) {
+          *why = "dense input size mismatch";
+          return false;
+        }
+        cur.d.assign(1, w.dims[1]);
+        break;
+      }
+      case OP_CONV: {
+        if (cur.d.size() != 3) { *why = "conv needs HWC input"; return false; }
+        int64_t oh = conv_out(cur.d[0], op.ai(A_KY), op.ai(A_PY), op.ai(A_SY));
+        int64_t ow = conv_out(cur.d[1], op.ai(A_KX), op.ai(A_PX), op.ai(A_SX));
+        if (oh <= 0 || ow <= 0) { *why = "conv output empty"; return false; }
+        cur.d = {oh, ow, op.ai(A_NKERN)};
+        break;
+      }
+      case OP_DECONV: {
+        if (cur.d.size() != 3) { *why = "deconv needs HWC"; return false; }
+        int64_t oh = (cur.d[0] - 1) * op.ai(A_SY) + op.ai(A_KY) - 2 * op.ai(A_PY);
+        int64_t ow = (cur.d[1] - 1) * op.ai(A_SX) + op.ai(A_KX) - 2 * op.ai(A_PX);
+        if (oh <= 0 || ow <= 0) { *why = "deconv output empty"; return false; }
+        cur.d = {oh, ow, op.ai(A_NKERN)};
+        break;
+      }
+      case OP_MAXPOOL:
+      case OP_AVGPOOL:
+      case OP_STOCHPOOL_EVAL: {
+        if (cur.d.size() != 3) { *why = "pool needs HWC"; return false; }
+        int64_t oh = conv_out(cur.d[0], op.ai(A_KY), 0, op.ai(A_SY));
+        int64_t ow = conv_out(cur.d[1], op.ai(A_KX), 0, op.ai(A_SX));
+        if (oh <= 0 || ow <= 0) { *why = "pool output empty"; return false; }
+        cur.d = {oh, ow, cur.d[2]};
+        break;
+      }
+      case OP_LRN:
+      case OP_DROPOUT:
+      case OP_ACTIVATION:
+        break;  // shape preserved
+      default:
+        *why = "unknown op type";
+        return false;
+    }
+    m->shapes.push_back(cur);
+  }
+  m->out_shape = cur;
+  return true;
+}
+
+// ------------------------------------------------------- activations
+
+void apply_act(uint32_t act, float *v, int64_t rows, int64_t cols) {
+  switch (act) {
+    case ACT_LINEAR:
+      return;
+    case ACT_TANH:
+      for (int64_t i = 0; i < rows * cols; ++i) v[i] = std::tanh(v[i]);
+      return;
+    case ACT_RELU:
+      for (int64_t i = 0; i < rows * cols; ++i) v[i] = v[i] > 0 ? v[i] : 0;
+      return;
+    case ACT_SIGMOID:
+      for (int64_t i = 0; i < rows * cols; ++i)
+        v[i] = 1.0f / (1.0f + std::exp(-v[i]));
+      return;
+    case ACT_LOG:
+      for (int64_t i = 0; i < rows * cols; ++i)
+        v[i] = std::log(v[i] + std::sqrt(v[i] * v[i] + 1.0f));
+      return;
+    case ACT_SOFTMAX:
+      for (int64_t r = 0; r < rows; ++r) {
+        float *row = v + r * cols;
+        float mx = row[0];
+        for (int64_t c = 1; c < cols; ++c) mx = std::max(mx, row[c]);
+        float s = 0;
+        for (int64_t c = 0; c < cols; ++c) {
+          row[c] = std::exp(row[c] - mx);
+          s += row[c];
+        }
+        for (int64_t c = 0; c < cols; ++c) row[c] /= s;
+      }
+      return;
+  }
+}
+
+// ------------------------------------------------------------ kernels
+
+// y[b, o] = sum_i x[b, i] * w[i, o] + bias[o]; blocked for locality.
+void dense(const float *x, const Tensor &w, const Tensor *bias,
+           float *y, int64_t batch) {
+  const int64_t ni = w.dims[0], no = w.dims[1];
+  for (int64_t b = 0; b < batch; ++b) {
+    float *yr = y + b * no;
+    if (bias) {
+      std::memcpy(yr, bias->data.data(), no * sizeof(float));
+    } else {
+      std::memset(yr, 0, no * sizeof(float));
+    }
+    const float *xr = x + b * ni;
+    for (int64_t i = 0; i < ni; ++i) {
+      const float xi = xr[i];
+      if (xi == 0.0f) continue;
+      const float *wr = w.data.data() + i * no;
+      for (int64_t o = 0; o < no; ++o) yr[o] += xi * wr[o];
+    }
+  }
+}
+
+// NHWC x HWIO -> NHWC direct convolution.
+void conv2d(const float *x, const Shape &in, const Op &op, float *y,
+            const Shape &out, int64_t batch) {
+  const Tensor &w = op.tensors.at(T_WEIGHTS);
+  const Tensor *bias = op.has(T_BIAS) ? &op.tensors.at(T_BIAS) : nullptr;
+  const int64_t H = in.d[0], W = in.d[1], C = in.d[2];
+  const int64_t OH = out.d[0], OW = out.d[1], K = out.d[2];
+  const int ky = op.ai(A_KY), kx = op.ai(A_KX);
+  const int sy = op.ai(A_SY), sx = op.ai(A_SX);
+  const int py = op.ai(A_PY), px = op.ai(A_PX);
+  for (int64_t b = 0; b < batch; ++b) {
+    const float *xb = x + b * H * W * C;
+    float *yb = y + b * OH * OW * K;
+    for (int64_t oy = 0; oy < OH; ++oy) {
+      for (int64_t ox = 0; ox < OW; ++ox) {
+        float *yo = yb + (oy * OW + ox) * K;
+        if (bias) {
+          std::memcpy(yo, bias->data.data(), K * sizeof(float));
+        } else {
+          std::memset(yo, 0, K * sizeof(float));
+        }
+        for (int iy = 0; iy < ky; ++iy) {
+          const int64_t sy_in = oy * sy - py + iy;
+          if (sy_in < 0 || sy_in >= H) continue;
+          for (int ix = 0; ix < kx; ++ix) {
+            const int64_t sx_in = ox * sx - px + ix;
+            if (sx_in < 0 || sx_in >= W) continue;
+            const float *xp = xb + (sy_in * W + sx_in) * C;
+            const float *wp = w.data.data() + ((iy * kx + ix) * C) * K;
+            for (int64_t c = 0; c < C; ++c) {
+              const float xv = xp[c];
+              if (xv == 0.0f) continue;
+              const float *wk = wp + c * K;
+              for (int64_t k = 0; k < K; ++k) yo[k] += xv * wk[k];
+            }
+          }
+        }
+      }
+    }
+  }
+}
+
+// Transposed conv: weights (ky, kx, n_kernels, c_in); scatter-add.
+void deconv2d(const float *x, const Shape &in, const Op &op, float *y,
+              const Shape &out, int64_t batch) {
+  const Tensor &w = op.tensors.at(T_WEIGHTS);
+  const Tensor *bias = op.has(T_BIAS) ? &op.tensors.at(T_BIAS) : nullptr;
+  const int64_t H = in.d[0], W = in.d[1], C = in.d[2];
+  const int64_t OH = out.d[0], OW = out.d[1], K = out.d[2];
+  const int ky = op.ai(A_KY), kx = op.ai(A_KX);
+  const int sy = op.ai(A_SY), sx = op.ai(A_SX);
+  const int py = op.ai(A_PY), px = op.ai(A_PX);
+  for (int64_t b = 0; b < batch; ++b) {
+    const float *xb = x + b * H * W * C;
+    float *yb = y + b * OH * OW * K;
+    for (int64_t i = 0; i < OH * OW; ++i) {
+      float *yo = yb + i * K;
+      if (bias) {
+        std::memcpy(yo, bias->data.data(), K * sizeof(float));
+      } else {
+        std::memset(yo, 0, K * sizeof(float));
+      }
+    }
+    for (int64_t iy = 0; iy < H; ++iy) {
+      for (int64_t ix = 0; ix < W; ++ix) {
+        const float *xp = xb + (iy * W + ix) * C;
+        for (int wy = 0; wy < ky; ++wy) {
+          const int64_t oy = iy * sy + wy - py;
+          if (oy < 0 || oy >= OH) continue;
+          for (int wx = 0; wx < kx; ++wx) {
+            const int64_t ox = ix * sx + wx - px;
+            if (ox < 0 || ox >= OW) continue;
+            float *yo = yb + (oy * OW + ox) * K;
+            const float *wp = w.data.data() + ((wy * kx + wx) * K) * C;
+            for (int64_t k = 0; k < K; ++k) {
+              const float *wk = wp + k * C;
+              float acc = 0;
+              for (int64_t c = 0; c < C; ++c) acc += xp[c] * wk[c];
+              yo[k] += acc;
+            }
+          }
+        }
+      }
+    }
+  }
+}
+
+enum class PoolKind { kMax, kAvg, kStochEval };
+
+void pool2d(const float *x, const Shape &in, const Op &op, float *y,
+            const Shape &out, int64_t batch, PoolKind kind) {
+  const int64_t H = in.d[0], W = in.d[1], C = in.d[2];
+  const int64_t OH = out.d[0], OW = out.d[1];
+  const int ky = op.ai(A_KY), kx = op.ai(A_KX);
+  const int sy = op.ai(A_SY), sx = op.ai(A_SX);
+  for (int64_t b = 0; b < batch; ++b) {
+    const float *xb = x + b * H * W * C;
+    float *yb = y + b * OH * OW * C;
+    for (int64_t oy = 0; oy < OH; ++oy) {
+      for (int64_t ox = 0; ox < OW; ++ox) {
+        float *yo = yb + (oy * OW + ox) * C;
+        for (int64_t c = 0; c < C; ++c) {
+          float mx = -1e30f, sum = 0, asum = 0, wsum = 0;
+          for (int iy = 0; iy < ky; ++iy) {
+            const int64_t yy = oy * sy + iy;
+            if (yy >= H) continue;
+            for (int ix = 0; ix < kx; ++ix) {
+              const int64_t xx = ox * sx + ix;
+              if (xx >= W) continue;
+              const float v = xb[(yy * W + xx) * C + c];
+              mx = std::max(mx, v);
+              sum += v;
+              asum += std::fabs(v);
+              wsum += v * std::fabs(v);
+            }
+          }
+          switch (kind) {
+            case PoolKind::kMax: yo[c] = mx; break;
+            case PoolKind::kAvg: yo[c] = sum / (ky * kx); break;
+            case PoolKind::kStochEval:
+              // probability-weighted average, p ∝ |x|
+              yo[c] = wsum / std::max(asum, 1e-12f);
+              break;
+          }
+        }
+      }
+    }
+  }
+}
+
+// Across-channel LRN: y = x * (k + alpha * windowed sum of x^2)^-beta
+void lrn(const float *x, float *y, int64_t rows, int64_t C,
+         const Op &op) {
+  const int n = op.ai(A_LRN_N, 5), half = n / 2;
+  const float alpha = static_cast<float>(op.a(A_ALPHA, 1e-4));
+  const float beta = static_cast<float>(op.a(A_BETA, 0.75));
+  const float k = static_cast<float>(op.a(A_K, 2.0));
+  for (int64_t r = 0; r < rows; ++r) {
+    const float *xr = x + r * C;
+    float *yr = y + r * C;
+    for (int64_t c = 0; c < C; ++c) {
+      float s = 0;
+      const int64_t lo = c - half > 0 ? c - half : 0;
+      const int64_t hi = c + half < C - 1 ? c + half : C - 1;
+      for (int64_t j = lo; j <= hi; ++j) s += xr[j] * xr[j];
+      yr[c] = xr[c] * std::pow(k + alpha * s, -beta);
+    }
+  }
+}
+
+}  // namespace
+
+// ------------------------------------------------------------- C API
+
+extern "C" VelesModel *veles_load(const char *path, char *err,
+                                  int err_len) {
+  FILE *f = fopen(path, "rb");
+  if (!f) {
+    fail(err, err_len, "cannot open model file");
+    return nullptr;
+  }
+  std::unique_ptr<VelesModel> m(new VelesModel);
+  Reader r(f);
+  char magic[4];
+  r.bytes(magic, 4);
+  if (!r.ok || std::memcmp(magic, "VTPN", 4) != 0) {
+    fail(err, err_len, "bad magic (not a VTPN model)");
+    fclose(f);
+    return nullptr;
+  }
+  const uint32_t version = r.rd<uint32_t>();
+  if (version != 1) {
+    fail(err, err_len, "unsupported VTPN version");
+    fclose(f);
+    return nullptr;
+  }
+  const uint32_t n_ops = r.rd<uint32_t>();
+  const int64_t in_rank = r.rd<int64_t>();
+  if (!r.ok || n_ops > 4096 || in_rank <= 0 || in_rank > 8) {
+    fail(err, err_len, "corrupt header");
+    fclose(f);
+    return nullptr;
+  }
+  for (int64_t i = 0; i < in_rank; ++i)
+    m->in_shape.d.push_back(r.rd<int64_t>());
+  for (uint32_t i = 0; i < n_ops && r.ok; ++i) {
+    Op op;
+    op.type = r.rd<uint32_t>();
+    op.act = r.rd<uint32_t>();
+    const uint32_t n_attr = r.rd<uint32_t>();
+    for (uint32_t j = 0; j < n_attr && r.ok; ++j) {
+      const uint32_t key = r.rd<uint32_t>();
+      op.attr[key] = r.rd<double>();
+    }
+    const uint32_t n_tensor = r.rd<uint32_t>();
+    for (uint32_t j = 0; j < n_tensor && r.ok; ++j) {
+      const uint32_t id = r.rd<uint32_t>();
+      const uint32_t ndim = r.rd<uint32_t>();
+      if (ndim > 8) { r.ok = false; break; }
+      Tensor t;
+      for (uint32_t d = 0; d < ndim; ++d)
+        t.dims.push_back(r.rd<int64_t>());
+      const int64_t n = t.numel();
+      if (n < 0 || n > (1LL << 33)) { r.ok = false; break; }
+      t.data.resize(n);
+      r.bytes(t.data.data(), n * sizeof(float));
+      op.tensors.emplace(id, std::move(t));
+    }
+    m->ops.push_back(std::move(op));
+  }
+  fclose(f);
+  if (!r.ok) {
+    fail(err, err_len, "truncated or corrupt model file");
+    return nullptr;
+  }
+  std::string why;
+  if (!infer_shapes(m.get(), &why)) {
+    fail(err, err_len, why.c_str());
+    return nullptr;
+  }
+  return m.release();
+}
+
+extern "C" void veles_free(VelesModel *model) { delete model; }
+
+extern "C" int veles_input_rank(const VelesModel *m) {
+  return static_cast<int>(m->in_shape.d.size());
+}
+
+extern "C" void veles_input_dims(const VelesModel *m, int64_t *dims) {
+  for (size_t i = 0; i < m->in_shape.d.size(); ++i) dims[i] = m->in_shape.d[i];
+}
+
+extern "C" int64_t veles_output_size(const VelesModel *m) {
+  return m->out_shape.numel();
+}
+
+extern "C" int veles_num_ops(const VelesModel *m) {
+  return static_cast<int>(m->ops.size());
+}
+
+extern "C" int veles_run(const VelesModel *m, const float *input,
+                         int batch, float *out) {
+  if (batch <= 0) return -1;
+  Shape cur = m->in_shape;
+  std::vector<float> buf_a(input, input + batch * cur.numel());
+  std::vector<float> buf_b;
+  for (size_t i = 0; i < m->ops.size(); ++i) {
+    const Op &op = m->ops[i];
+    const Shape &next = m->shapes[i];
+    buf_b.assign(static_cast<size_t>(batch * next.numel()), 0.0f);
+    const float *x = buf_a.data();
+    float *y = buf_b.data();
+    switch (op.type) {
+      case OP_DENSE: {
+        const Tensor &w = op.tensors.at(T_WEIGHTS);
+        dense(x, w, op.has(T_BIAS) ? &op.tensors.at(T_BIAS) : nullptr,
+              y, batch);
+        apply_act(op.act, y, batch, next.numel());
+        break;
+      }
+      case OP_CONV:
+        conv2d(x, cur, op, y, next, batch);
+        apply_act(op.act, y, batch * next.d[0] * next.d[1], next.d[2]);
+        break;
+      case OP_DECONV:
+        deconv2d(x, cur, op, y, next, batch);
+        apply_act(op.act, y, batch * next.d[0] * next.d[1], next.d[2]);
+        break;
+      case OP_MAXPOOL:
+        pool2d(x, cur, op, y, next, batch, PoolKind::kMax);
+        break;
+      case OP_AVGPOOL:
+        pool2d(x, cur, op, y, next, batch, PoolKind::kAvg);
+        break;
+      case OP_STOCHPOOL_EVAL:
+        pool2d(x, cur, op, y, next, batch, PoolKind::kStochEval);
+        break;
+      case OP_LRN:
+        lrn(x, y, batch * next.d[0] * next.d[1], next.d[2], op);
+        break;
+      case OP_DROPOUT:
+        std::memcpy(y, x, batch * next.numel() * sizeof(float));
+        break;
+      case OP_ACTIVATION:
+        std::memcpy(y, x, batch * next.numel() * sizeof(float));
+        apply_act(op.act, y, batch, next.numel());
+        break;
+      default:
+        return -2;
+    }
+    buf_a.swap(buf_b);
+    cur = next;
+  }
+  std::memcpy(out, buf_a.data(),
+              static_cast<size_t>(batch * cur.numel()) * sizeof(float));
+  return 0;
+}

@@ -1,0 +1,60 @@
+// veles_infer: standalone CLI proving the no-Python deployment path
+// (reference parity: libVeles's sample runner).
+//
+//   veles_infer model.vtpn input.f32 [batch]
+//
+// input.f32 holds batch * input_size little-endian float32s; the
+// outputs are printed one sample per line.
+
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#include "veles_c.h"
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s model.vtpn input.f32 [batch]\n",
+                 argv[0]);
+    return 2;
+  }
+  char err[256] = {0};
+  VelesModel *m = veles_load(argv[1], err, sizeof(err));
+  if (!m) {
+    std::fprintf(stderr, "load failed: %s\n", err);
+    return 1;
+  }
+  const int rank = veles_input_rank(m);
+  std::vector<int64_t> dims(rank);
+  veles_input_dims(m, dims.data());
+  int64_t in_size = 1;
+  for (int64_t d : dims) in_size *= d;
+  const int batch = argc > 3 ? std::atoi(argv[3]) : 1;
+
+  std::vector<float> input(batch * in_size);
+  FILE *f = std::fopen(argv[2], "rb");
+  if (!f || std::fread(input.data(), sizeof(float), input.size(), f) !=
+                input.size()) {
+    std::fprintf(stderr, "cannot read %lld floats from %s\n",
+                 static_cast<long long>(input.size()), argv[2]);
+    if (f) std::fclose(f);
+    veles_free(m);
+    return 1;
+  }
+  std::fclose(f);
+
+  std::vector<float> out(batch * veles_output_size(m));
+  if (veles_run(m, input.data(), batch, out.data()) != 0) {
+    std::fprintf(stderr, "inference failed\n");
+    veles_free(m);
+    return 1;
+  }
+  const int64_t os = veles_output_size(m);
+  for (int b = 0; b < batch; ++b) {
+    for (int64_t i = 0; i < os; ++i)
+      std::printf("%s%g", i ? " " : "", out[b * os + i]);
+    std::printf("\n");
+  }
+  veles_free(m);
+  return 0;
+}

@@ -1,0 +1,165 @@
+"""Native C++ inference runtime (native/ + veles_tpu/export.py +
+veles_tpu/native.py) — the libVeles equivalent (SURVEY.md §3.3).
+The python numpy forward path is the oracle; the C++ runtime must
+match it to float tolerance on every exported op."""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.export import export_model
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+def build_and_train(layers, shape=(12, 12, 1), n_classes=4,
+                    max_epochs=1, loss="softmax", mb=20):
+    prng.seed_all(777)
+    train, valid, _ = synthetic_classification(
+        80, 40, shape, n_classes=n_classes, seed=42)
+    if loss == "mse":  # autoencoder: target = the input itself
+        train = (train[0], train[1], train[0])
+        valid = (valid[0], valid[1], valid[0])
+    w = StandardWorkflow(
+        loader_factory=lambda wf: ArrayLoader(
+            wf, train=train, valid=valid, minibatch_size=mb,
+            name="loader"),
+        layers=layers, loss_function=loss,
+        decision_config={"max_epochs": max_epochs}, name="native_wf")
+    w.initialize(device=NumpyDevice())
+    w.run()
+    return w
+
+
+def python_forward(w, x):
+    out = np.asarray(x, np.float32)
+    for f in w.forwards:
+        params = {k: np.asarray(v) for k, v in f.gather_params().items()}
+        out, _ = f.apply_fwd(params, out, rng=None, train=False)
+        out = np.asarray(out)
+    return out
+
+
+def roundtrip(w, tmp_path, batch=8):
+    from veles_tpu.native import NativeModel
+
+    path = str(tmp_path / "model.vtpn")
+    export_model(w, path)
+    model = NativeModel(path)
+    x = w.loader.original_data.mem[:batch]
+    want = python_forward(w, x).reshape(batch, -1)
+    got = model.run(x)
+    model.close()
+    return want, got
+
+
+class TestNativeRuntime:
+    def test_dense_net(self, tmp_path):
+        w = build_and_train([
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": {"learning_rate": 0.1}},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.1}},
+        ])
+        want, got = roundtrip(w, tmp_path)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        np.testing.assert_allclose(got.sum(-1), 1.0, atol=1e-5)
+
+    def test_conv_net_with_everything(self, tmp_path):
+        """conv+relu, LRN, maxpool, dropout(identity), FC tanh,
+        softmax — the AlexNet op family end to end."""
+        w = build_and_train([
+            {"type": "conv_relu",
+             "->": {"n_kernels": 6, "kx": 3, "ky": 3, "padding": 1,
+                    "sliding": 2}, "<-": {"learning_rate": 0.05}},
+            {"type": "norm", "->": {"n": 3}, "<-": {}},
+            {"type": "max_pooling", "->": {"kx": 2, "ky": 2,
+                                           "sliding": 2}, "<-": {}},
+            {"type": "dropout", "->": {"dropout_ratio": 0.4}, "<-": {}},
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 12},
+             "<-": {"learning_rate": 0.05}},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.05}},
+        ])
+        want, got = roundtrip(w, tmp_path)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_avgpool_and_stochpool(self, tmp_path):
+        w = build_and_train([
+            {"type": "avg_pooling", "->": {"kx": 2, "ky": 2,
+                                           "sliding": 2}, "<-": {}},
+            {"type": "stochastic_pooling",
+             "->": {"kx": 2, "ky": 2, "sliding": 2}, "<-": {}},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.1}},
+        ])
+        want, got = roundtrip(w, tmp_path)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_autoencoder_with_deconv(self, tmp_path):
+        w = build_and_train([
+            {"type": "conv_tanh",
+             "->": {"n_kernels": 4, "kx": 4, "ky": 4, "sliding": 2,
+                    "padding": 1}, "<-": {"learning_rate": 0.02}},
+            {"type": "deconv",
+             "->": {"n_kernels": 1, "kx": 4, "ky": 4, "sliding": 2,
+                    "padding": 1}, "<-": {"learning_rate": 0.02}},
+        ], loss="mse")
+        want, got = roundtrip(w, tmp_path)
+        np.testing.assert_allclose(got, want.reshape(got.shape),
+                                   atol=1e-4)
+
+    def test_model_metadata(self, tmp_path):
+        from veles_tpu.native import NativeModel
+
+        w = build_and_train([
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.1}}])
+        path = str(tmp_path / "m.vtpn")
+        export_model(w, path)
+        m = NativeModel(path)
+        assert m.input_shape == (12, 12, 1)
+        assert m.output_size == 4
+        assert m.num_ops == 1
+        m.close()
+
+    def test_bad_file_rejected(self, tmp_path):
+        from veles_tpu.native import NativeModel
+
+        bad = tmp_path / "junk.vtpn"
+        bad.write_bytes(b"NOPE" + b"\x00" * 64)
+        with pytest.raises(ValueError, match="magic"):
+            NativeModel(str(bad))
+
+    def test_truncated_file_rejected(self, tmp_path):
+        from veles_tpu.native import NativeModel
+
+        w = build_and_train([
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.1}}])
+        path = tmp_path / "m.vtpn"
+        export_model(w, str(path))
+        data = path.read_bytes()
+        (tmp_path / "trunc.vtpn").write_bytes(data[:len(data) // 2])
+        with pytest.raises(ValueError, match="truncated|corrupt"):
+            NativeModel(str(tmp_path / "trunc.vtpn"))
+
+    def test_wrong_input_shape_rejected(self, tmp_path):
+        from veles_tpu.native import NativeModel
+
+        w = build_and_train([
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.1}}])
+        path = str(tmp_path / "m.vtpn")
+        export_model(w, path)
+        m = NativeModel(path)
+        with pytest.raises(ValueError, match="sample shape"):
+            m.run(np.zeros((2, 5, 5, 1), np.float32))
+        m.close()

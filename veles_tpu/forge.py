@@ -109,26 +109,43 @@ class ForgePackage(Logger):
                 verify: bool = True) -> Dict[str, Any]:
         """Extract + checksum-verify; returns the manifest with an
         added 'root' key pointing at the extracted directory."""
+        import shutil
+        import tempfile
+
         manifest = ForgePackage.read_manifest(pkg_path)
         target = os.path.join(dest_dir,
                               f"{manifest['name']}-{manifest['version']}")
-        os.makedirs(target, exist_ok=True)
-        with tarfile.open(pkg_path, "r:gz") as tar:
-            for member in tar.getmembers():
-                # refuse path traversal — packages may come from anyone
-                mpath = os.path.normpath(member.name)
-                if mpath.startswith("..") or os.path.isabs(mpath) \
-                        or not (member.isfile() or member.isdir()):
-                    raise ValueError(
-                        f"unsafe member in package: {member.name!r}")
-            tar.extractall(target, filter="data")
-        if verify:
-            for fname, want in manifest["sha256"].items():
-                got = _sha256(os.path.join(target, fname))
-                if got != want:
-                    raise ValueError(
-                        f"checksum mismatch for {fname}: "
-                        f"{got[:12]} != {want[:12]}")
+        os.makedirs(dest_dir, exist_ok=True)
+        # extract + verify in a staging dir so a failed verification
+        # never leaves tampered files at the install path
+        staging = tempfile.mkdtemp(dir=dest_dir, prefix=".staging-")
+        try:
+            with tarfile.open(pkg_path, "r:gz") as tar:
+                for member in tar.getmembers():
+                    # refuse path traversal — packages may come from
+                    # anyone
+                    mpath = os.path.normpath(member.name)
+                    if mpath.startswith("..") or os.path.isabs(mpath) \
+                            or not (member.isfile() or member.isdir()):
+                        raise ValueError(
+                            f"unsafe member in package: {member.name!r}")
+                try:
+                    tar.extractall(staging, filter="data")
+                except TypeError:  # pre-3.12 tarfile without filter=
+                    tar.extractall(staging)  # members validated above
+            if verify:
+                for fname, want in manifest["sha256"].items():
+                    got = _sha256(os.path.join(staging, fname))
+                    if got != want:
+                        raise ValueError(
+                            f"checksum mismatch for {fname}: "
+                            f"{got[:12]} != {want[:12]}")
+            if os.path.isdir(target):
+                shutil.rmtree(target)
+            os.rename(staging, target)
+        except Exception:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
         manifest["root"] = target
         return manifest
 

@@ -58,6 +58,11 @@ class FullBatchLoader(Loader):
         from veles_tpu.loader.base import TRAIN
         pre = self.original_data.mem
         if self.normalizer is None:
+            if self.class_lengths[TRAIN] == 0:
+                raise ValueError(
+                    f"{self.name}: normalization_type="
+                    f"{self.normalization_type!r} needs a TRAIN split "
+                    f"to fit on (class_lengths={self.class_lengths})")
             self.normalizer = make_normalizer(
                 self.normalization_type, **self.normalization_parameters)
             self.normalizer.fit(pre[self.class_offset(TRAIN):])
@@ -66,6 +71,19 @@ class FullBatchLoader(Loader):
         self.original_data.mem = self.normalizer.apply(pre)
         if targets_alias_data:  # autoencoder: target = normalized input
             self.original_targets.mem = self.original_data.mem
+
+    def getstate_dropping(self, *vector_names: str) -> dict:
+        """__getstate__ minus the bulk of named Vectors — for loaders
+        whose load_data regenerates content (files, synthetic)."""
+        import copy
+        d = super().__getstate__()
+        for key in vector_names:
+            vec = d.get(key)
+            if vec is not None:
+                vec = copy.copy(vec)
+                vec.__setstate__({"name": vec.name, "mem": None})
+                d[key] = vec
+        return d
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)

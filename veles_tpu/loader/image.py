@@ -82,14 +82,9 @@ class FileListImageLoader(FullBatchLoader):
 
     def __getstate__(self) -> dict:
         # decoded pixels are regenerable from the file lists — drop the
-        # bulk like the synthetic loaders do (snapshots stay small)
-        d = super().__getstate__()
-        import copy
-        for key in ("original_data", "original_labels"):
-            vec = copy.copy(d[key])
-            vec.__setstate__({"name": vec.name, "mem": None})
-            d[key] = vec
-        return d
+        # bulk (snapshots stay small)
+        return self.getstate_dropping("original_data",
+                                      "original_labels")
 
 
 class ImageDirectoryLoader(FileListImageLoader):

@@ -54,17 +54,10 @@ class SyntheticClassificationLoader(FullBatchLoader):
             self.original_targets.mem = self.original_data.mem
 
     def __getstate__(self) -> dict:
-        d = super().__getstate__()
         # drop the bulky arrays; load_data regenerates them on resume
-        for key in ("original_data", "original_labels",
-                    "original_targets"):
-            vec = d.get(key)
-            if vec is not None:
-                import copy
-                vec = copy.copy(vec)
-                vec.__setstate__({"name": vec.name, "mem": None})
-                d[key] = vec
-        return d
+        return self.getstate_dropping("original_data",
+                                      "original_labels",
+                                      "original_targets")
 
 
 class MnistLoader(SyntheticClassificationLoader):

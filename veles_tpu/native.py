@@ -1,0 +1,106 @@
+"""ctypes bridge to the native C++ inference runtime.
+
+Builds ``native/libveles_tpu.so`` on demand (make + g++; no pybind11 in
+this environment — the C API in native/include/veles_c.h is the ABI)
+and wraps it in a numpy-friendly ``NativeModel``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libveles_tpu.so")
+_lib: Optional[ctypes.CDLL] = None
+
+
+def ensure_built(force: bool = False) -> str:
+    """Build the shared library if missing or older than its sources."""
+    src = os.path.join(_NATIVE_DIR, "src", "libveles.cc")
+    hdr = os.path.join(_NATIVE_DIR, "include", "veles_c.h")
+    if not force and os.path.exists(_LIB_PATH):
+        newest_src = max(os.path.getmtime(src), os.path.getmtime(hdr))
+        if os.path.getmtime(_LIB_PATH) >= newest_src:
+            return _LIB_PATH
+    proc = subprocess.run(["make", "-C", _NATIVE_DIR],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed:\n{proc.stdout}\n{proc.stderr}")
+    return _LIB_PATH
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(ensure_built())
+        lib.veles_load.restype = ctypes.c_void_p
+        lib.veles_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+        lib.veles_free.argtypes = [ctypes.c_void_p]
+        lib.veles_input_rank.restype = ctypes.c_int
+        lib.veles_input_rank.argtypes = [ctypes.c_void_p]
+        lib.veles_input_dims.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+        lib.veles_output_size.restype = ctypes.c_int64
+        lib.veles_output_size.argtypes = [ctypes.c_void_p]
+        lib.veles_num_ops.restype = ctypes.c_int
+        lib.veles_num_ops.argtypes = [ctypes.c_void_p]
+        lib.veles_run.restype = ctypes.c_int
+        lib.veles_run.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+        _lib = lib
+    return _lib
+
+
+class NativeModel:
+    """A VTPN model executed by the C++ runtime."""
+
+    def __init__(self, path: str) -> None:
+        lib = _load_lib()
+        err = ctypes.create_string_buffer(256)
+        self._handle = lib.veles_load(path.encode(), err, len(err))
+        if not self._handle:
+            raise ValueError(
+                f"veles_load({path!r}): {err.value.decode() or 'failed'}")
+        self._lib = lib
+        rank = lib.veles_input_rank(self._handle)
+        dims = (ctypes.c_int64 * rank)()
+        lib.veles_input_dims(self._handle, dims)
+        self.input_shape = tuple(int(d) for d in dims)
+        self.output_size = int(lib.veles_output_size(self._handle))
+        self.num_ops = int(lib.veles_num_ops(self._handle))
+
+    def run(self, x: np.ndarray) -> np.ndarray:
+        """Forward a batch: (B, *input_shape) float32 -> (B, out)."""
+        x = np.ascontiguousarray(x, np.float32)
+        if tuple(x.shape[1:]) != self.input_shape:
+            raise ValueError(f"input sample shape {x.shape[1:]} != "
+                             f"model's {self.input_shape}")
+        batch = x.shape[0]
+        out = np.empty((batch, self.output_size), np.float32)
+        rc = self._lib.veles_run(
+            self._handle,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), batch,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError(f"veles_run failed with code {rc}")
+        return out
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.veles_free(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:  # interpreter teardown
+            pass

@@ -119,10 +119,13 @@ class StochasticPooling(PoolingBase):
         return jnp.stack(parts, axis=3)
 
     def apply_fwd(self, params, x, rng=None, train=True):
-        if not train or rng is None:
+        if not train:
             y = self.apply(params, {"input": x})["output"]
             return y, (x, y)
         if isinstance(x, np.ndarray):
+            # numpy path draws from the named stream — rng-gating this
+            # branch would silently run EVAL pooling during eager
+            # training and hand the backward float "indices"
             from veles_tpu import prng as prng_mod
             gen = prng_mod.get("stochastic_pooling").numpy
             w = self._windows(x)
@@ -131,6 +134,9 @@ class StochasticPooling(PoolingBase):
             y = np.take_along_axis(w, idx[:, :, :, None, :],
                                    axis=3)[:, :, :, 0, :]
             return y, (x, idx)
+        if rng is None:
+            raise ValueError(f"{self.name}: traced train mode needs "
+                             "an rng key")
         import jax
         import jax.numpy as jnp
         w = self._jax_windows(x)
@@ -139,6 +145,12 @@ class StochasticPooling(PoolingBase):
         y = jnp.take_along_axis(w, idx[:, :, :, None, :],
                                 axis=3)[:, :, :, 0, :]
         return y, (x, idx)
+
+    def eager_rng(self):
+        if self.device is not None and self.device.is_jax:
+            from veles_tpu import prng as prng_mod
+            return prng_mod.get("stochastic_pooling").next_key()
+        return None
 
 
 class GDMaxPooling(GradientUnit):
