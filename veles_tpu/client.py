@@ -39,15 +39,15 @@ class SlaveClient(Logger):
 
     def _run_job(self, job: dict) -> dict:
         w = self.workflow
-        loader, fused, ev = w.loader, w.fused, w.evaluator
+        loader, fused = w.loader, w.fused
         loader.apply_data_from_master(job["loader"])
         fused.set_host_params(job["params"])
         if job.get("lr_scales"):
             fused.lr_scales = list(job["lr_scales"])
         fused.run()
-        metrics = {"n_err": float(np.asarray(ev.n_err.current()).sum()),
-                   "loss_sum": float(np.asarray(ev.loss.current()).sum()),
-                   "count": float(np.asarray(ev.count.current()).sum())}
+        n_err, loss_sum, count, _ = fused.take_class_metrics()
+        metrics = {"n_err": n_err, "loss_sum": loss_sum,
+                   "count": count}
         diff = None
         if loader.minibatch_class == TRAIN:
             diff = _tree_sub(fused.host_params(), job["params"])

@@ -64,6 +64,13 @@ class Loader(Unit, Distributable):
         #: the fused TPU path gathers rows on-device from the resident
         #: dataset; host minibatch assembly is skipped entirely then
         self.host_fill_enabled = True
+        #: >1 = emit up to this many SAME-CLASS minibatches per firing
+        #: (the fused runner scans over them in ONE device dispatch,
+        #: amortizing per-execute latency); flags describe the LAST one
+        self.superstep = 1
+        self.superstep_indices: Optional[np.ndarray] = None  # (k, mb)
+        self.superstep_mask: Optional[np.ndarray] = None     # (k, mb)
+        self.superstep_k = 0
         self.last_minibatch = Bool(False)   # last of the TRAIN class
         self.epoch_ended = Bool(False)
         self.class_ended = Bool(False)      # last minibatch of any class
@@ -153,25 +160,33 @@ class Loader(Unit, Distributable):
         order = self._order[klass]
         n = len(order)
         mb = self.max_minibatch_size
-        start = self._pos
-        stop = min(start + mb, n)
-        raw = order[start:stop]
-        size = len(raw)
-        # pad to static shape; padded rows masked out of metrics
-        idx = np.resize(raw, mb).astype(np.int32)
-        mask = np.zeros(mb, np.float32)
-        mask[:size] = 1.0
+        remaining = -(-(n - self._pos) // mb)  # minibatches left
+        k = max(1, min(self.superstep, remaining))
+
+        idxs = np.empty((k, mb), np.int32)
+        masks = np.zeros((k, mb), np.float32)
+        for j in range(k):
+            start = self._pos
+            stop = min(start + mb, n)
+            raw = order[start:stop]
+            size = len(raw)
+            # pad to static shape; padded rows masked out of metrics
+            idxs[j] = np.resize(raw, mb)
+            masks[j, :size] = 1.0
+            self.minibatch_offset = start
+            self.current_minibatch_size = size
+            self._pos = stop
+        self.superstep_indices = idxs
+        self.superstep_mask = masks
+        self.superstep_k = k
 
         self.minibatch_class = klass
-        self.minibatch_offset = start
-        self.current_minibatch_size = size
-        self.minibatch_indices.map_invalidate()[:] = idx
-        self.minibatch_mask.map_invalidate()[:] = mask
+        self.minibatch_indices.map_invalidate()[:] = idxs[-1]
+        self.minibatch_mask.map_invalidate()[:] = masks[-1]
         if self.host_fill_enabled:
             self.fill_minibatch()
 
-        self._pos = stop
-        if stop >= n:  # class exhausted
+        if self._pos >= n:  # class exhausted
             self.class_ended.set(True)
             if klass == TRAIN:
                 self.last_minibatch.set(True)
@@ -197,5 +212,11 @@ class Loader(Unit, Distributable):
         mask = np.zeros(self.max_minibatch_size, np.float32)
         mask[:data["size"]] = 1.0
         self.minibatch_mask.map_invalidate()[:] = mask
+        # slave jobs are single minibatches — the fused runner reads
+        # the superstep arrays, so mirror them here
+        self.superstep_indices = np.asarray(data["indices"],
+                                            np.int32)[None]
+        self.superstep_mask = mask[None]
+        self.superstep_k = 1
         self.fill_minibatch()
 

@@ -10,23 +10,25 @@ would dispatch dozens of tiny XLA computations per step and lose badly
     -> evaluator metrics + err_output
     -> every gradient unit's backward + SGD update
 
-— is traced into ONE jitted function.  XLA fuses the elementwise chains
-into the matmuls/convs, keeps everything in HBM, and the parameter /
-optimizer pytrees are DONATED so updates are in-place in device memory.
-Separate train/eval traces give dropout-style units their two modes
-without traced branching.
+— is traced into ONE jitted function, and a ``lax.scan`` over up to
+``loader.superstep`` same-class minibatches runs MANY iterations per
+device dispatch (amortizing per-execute latency, which dominates on
+tunneled/remote TPUs).  Metrics and the confusion matrix accumulate
+ON DEVICE in donated carry buffers; the host fetches 12 bytes once per
+class end instead of 3 scalars per minibatch.  Matmuls/convs run in
+the device's ``compute_dtype`` (bfloat16 on TPU — the MXU's native
+format) against float32 master weights.
 
 ``FusedStepRunner`` is a drop-in graph node: it sits where the
 forwards+evaluator+gds chain would, reads the loader's minibatch
 indices, and rebinds every unit's Vectors (weights, output, metrics) to
 the step outputs — so Decision, Snapshotter, and plotters observe
-exactly what they would in eager mode, and ``map_read`` on any Vector
-still yields the current value.
+exactly what they would in eager mode.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -38,7 +40,7 @@ from veles_tpu import prng
 class FusedStepRunner(AcceleratedUnit):
     def __init__(self, workflow=None, loader=None, forwards=None,
                  evaluator=None, gds=None, rng_stream: str = "fused",
-                 **kwargs: Any) -> None:
+                 compute_dtype: Any = None, **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.loader = loader
         self.forwards: List[Any] = forwards or []
@@ -47,6 +49,8 @@ class FusedStepRunner(AcceleratedUnit):
         #: for frozen/param-less layers that still need err routing)
         self.gds: List[Any] = gds or []
         self.rng_stream = rng_stream
+        #: None = the device's policy (bf16 on TPU, f32 elsewhere)
+        self.compute_dtype = compute_dtype
         #: a jax.sharding.Mesh when DataParallel is installed — the
         #: steps are then jitted with the minibatch sharded over the
         #: mesh's data axis and params replicated (parallel/ package)
@@ -56,14 +60,17 @@ class FusedStepRunner(AcceleratedUnit):
         self._params: Optional[Dict[str, Dict[str, Any]]] = None
         self._opt: Optional[Dict[str, Dict[str, Any]]] = None
         self._rng_counter = 0
-        self._conf_handles: List[Any] = []
+        #: on-device metric accumulator [n_err, loss_sum, count] and
+        #: confusion accumulator, reset at each take_class_metrics()
+        self._acc: Any = None
+        self._conf: Any = None
         #: per-GD lr multipliers (traced arg — lr_adjust writes these
         #: without triggering a retrace)
         self.lr_scales = [1.0] * len(self.gds)
 
     _unpicklable = AcceleratedUnit._unpicklable + (
         "_train_step", "_eval_step", "_params", "_opt", "mesh",
-        "_batch_sharding")
+        "_batch_sharding", "_acc", "_conf")
 
     # -- pytree assembly ----------------------------------------------
 
@@ -99,34 +106,66 @@ class FusedStepRunner(AcceleratedUnit):
     def _has_targets(self) -> bool:
         return hasattr(self.evaluator, "target")
 
+    def _want_confusion(self) -> bool:
+        ev = self.evaluator
+        return bool(getattr(ev, "compute_confusion", False)) and \
+            getattr(ev, "n_classes", None) is not None
+
+    def _conf_shape(self) -> Tuple[int, int]:
+        if self._want_confusion():
+            n = self.evaluator.n_classes
+            return (n, n)
+        return (1, 1)
+
+    def _resolved_dtype(self):
+        import jax.numpy as jnp
+        cd = self.compute_dtype
+        if cd is None and self.device is not None:
+            cd = self.device.compute_dtype
+        return jnp.dtype(cd) if cd is not None else jnp.float32
+
     def _build_steps(self) -> None:
         import jax
         import jax.numpy as jnp
+        from jax import lax
 
         forwards = list(self.forwards)
         gds = list(self.gds)
         evaluator = self.evaluator
         n_fwd = len(forwards)
-        has_targets = self._has_targets()
-        want_confusion = bool(getattr(evaluator, "compute_confusion",
-                                      False))
-        n_classes = getattr(evaluator, "n_classes", None)
+        want_confusion = self._want_confusion()
         seed = prng.get(self.rng_stream).seed
+        cd = self._resolved_dtype()
+        mixed = cd != jnp.float32
+        out_shape = tuple(forwards[-1].output.shape)
+
+        def cast(tree):
+            if not mixed:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(cd) if a.dtype == jnp.float32 else a,
+                tree)
 
         def forward_pass(params, x, rng_counter, train: bool):
             residuals = []
+            if mixed:
+                x = x.astype(cd)
             for i, f in enumerate(forwards):
                 rng = jax.random.fold_in(
-                    jax.random.fold_in(jax.random.key(seed), rng_counter), i) \
+                    jax.random.fold_in(jax.random.key(seed),
+                                       rng_counter), i) \
                     if f.stochastic else None
-                x, res = f.apply_fwd(params[f.name], x, rng=rng, train=train)
+                x, res = f.apply_fwd(params[f.name], x, rng=rng,
+                                     train=train)
                 residuals.append(res)
             return x, residuals
 
         def metrics_of(out, target, mask):
-            m = evaluator.metrics_fn(out, target, mask)
-            if want_confusion and n_classes is not None:
-                conf = jnp.zeros((n_classes, n_classes), jnp.int32)
+            m = evaluator.metrics_fn(out.astype(jnp.float32), target,
+                                     mask)
+            if want_confusion:
+                n = evaluator.n_classes
+                conf = jnp.zeros((n, n), jnp.int32)
                 conf = conf.at[target, m["max_idx"]].add(
                     mask.astype(jnp.int32))
                 m["confusion"] = conf
@@ -137,37 +176,71 @@ class FusedStepRunner(AcceleratedUnit):
             t = jnp.take(target_store, indices, axis=0)
             return x, t
 
-        def train_step(params, opt, dataset, target_store, indices, mask,
-                       lr_scales, rng_counter):
-            x, target = gather(dataset, target_store, indices)
-            out, residuals = forward_pass(params, x, rng_counter, True)
-            m = metrics_of(out, target, mask)
-            err = m.pop("err_output")
-            new_params = dict(params)
-            new_opt = dict(opt)
-            for i in range(n_fwd - 1, -1, -1):
-                f, gd = forwards[i], gds[i]
-                if gd is None:
-                    continue
-                err_in, grads = gd.backward_from_saved(
-                    params[f.name], residuals[i], err)
-                if grads:
-                    p, v = gd.update_params(params[f.name], grads,
-                                            opt.get(gd.name, {}),
-                                            lr_scales[i])
-                    new_params[f.name] = p
-                    if gd.name in opt:
-                        new_opt[gd.name] = v
-                err = err_in
-            return new_params, new_opt, m
+        def accumulate(acc, conf, m):
+            acc = acc + jnp.stack([m["n_err"], m["loss_sum"],
+                                   m["count"]])
+            if want_confusion:
+                conf = conf + m["confusion"]
+            return acc, conf
 
-        def eval_step(params, dataset, target_store, indices, mask,
-                      rng_counter):
-            x, target = gather(dataset, target_store, indices)
-            out, _ = forward_pass(params, x, rng_counter, False)
-            m = metrics_of(out, target, mask)
-            m.pop("err_output")
-            return m, out
+        def train_body(dataset, target_store, lr_scales):
+            def body(carry, xs):
+                params, opt, acc, conf, rc = carry
+                indices, mask = xs
+                x, target = gather(dataset, target_store, indices)
+                cparams = cast(params)
+                out, residuals = forward_pass(cparams, x, rc, True)
+                m = metrics_of(out, target, mask)
+                err = m.pop("err_output")
+                if mixed:
+                    err = err.astype(cd)
+                new_params = dict(params)
+                new_opt = dict(opt)
+                for i in range(n_fwd - 1, -1, -1):
+                    f, gd = forwards[i], gds[i]
+                    if gd is None:
+                        continue
+                    err_in, grads = gd.backward_from_saved(
+                        cparams[f.name], residuals[i], err)
+                    if grads:
+                        p, v = gd.update_params(params[f.name], grads,
+                                                opt.get(gd.name, {}),
+                                                lr_scales[i])
+                        new_params[f.name] = p
+                        if gd.name in opt:
+                            new_opt[gd.name] = v
+                    err = err_in
+                acc, conf = accumulate(acc, conf, m)
+                return (new_params, new_opt, acc, conf, rc + 1), None
+            return body
+
+        def train_step(params, opt, acc, conf, dataset, target_store,
+                       indices, mask, lr_scales, rng_counter):
+            body = train_body(dataset, target_store, lr_scales)
+            (params, opt, acc, conf, _), _ = lax.scan(
+                body, (params, opt, acc, conf, rng_counter),
+                (indices, mask))
+            return params, opt, acc, conf
+
+        def eval_step(params, acc, conf, dataset, target_store,
+                      indices, mask, rng_counter):
+            cparams = cast(params)
+
+            def body(carry, xs):
+                acc, conf, _, rc = carry
+                indices, mask = xs
+                x, target = gather(dataset, target_store, indices)
+                out, _ = forward_pass(cparams, x, rc, False)
+                m = metrics_of(out, target, mask)
+                m.pop("err_output")
+                acc, conf = accumulate(acc, conf, m)
+                return (acc, conf, out.astype(jnp.float32), rc + 1), None
+
+            init_out = jnp.zeros(out_shape, jnp.float32)
+            (acc, conf, out, _), _ = lax.scan(
+                body, (acc, conf, init_out, rng_counter),
+                (indices, mask))
+            return acc, conf, out
 
         if self.mesh is not None:
             # SPMD data parallelism: minibatch rows sharded over the
@@ -175,20 +248,25 @@ class FusedStepRunner(AcceleratedUnit):
             # per-param batch reductions cross the sharded axis, so the
             # partitioner emits the gradient allreduce (ICI psum) —
             # this IS the master-slave aggregation, in-compiler.
-            from veles_tpu.parallel.mesh import (batch_sharding,
-                                                 replicated_sharding)
+            import jax.sharding as shd
+            from veles_tpu.parallel.mesh import replicated_sharding
             repl = replicated_sharding(self.mesh)
-            batch = self._batch_sharding = batch_sharding(self.mesh)
+            # superstep batches are (k, mb): shard the MINIBATCH axis
+            batch = self._batch_sharding = shd.NamedSharding(
+                self.mesh,
+                shd.PartitionSpec(None, self.mesh.axis_names[0]))
             self._train_step = jax.jit(
-                train_step, donate_argnums=(0, 1),
-                in_shardings=(repl, repl, repl, repl, batch, batch,
-                              repl, repl))
+                train_step, donate_argnums=(0, 1, 2, 3),
+                in_shardings=(repl, repl, repl, repl, repl, repl,
+                              batch, batch, repl, repl))
             self._eval_step = jax.jit(
-                eval_step,
-                in_shardings=(repl, repl, repl, batch, batch, repl))
+                eval_step, donate_argnums=(1, 2),
+                in_shardings=(repl, repl, repl, repl, repl,
+                              batch, batch, repl))
         else:
-            self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
-            self._eval_step = jax.jit(eval_step)
+            self._train_step = jax.jit(train_step,
+                                       donate_argnums=(0, 1, 2, 3))
+            self._eval_step = jax.jit(eval_step, donate_argnums=(1, 2))
 
     # -- lifecycle -----------------------------------------------------
 
@@ -215,12 +293,25 @@ class FusedStepRunner(AcceleratedUnit):
             return ld.original_targets.unmap()
         return ld.original_labels.unmap()
 
+    def _fresh_acc(self) -> Tuple[np.ndarray, np.ndarray]:
+        return (np.zeros(3, np.float32),
+                np.zeros(self._conf_shape(), np.int32))
+
+    def _superstep_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        ld = self.loader
+        if ld.superstep_k and ld.superstep_indices is not None:
+            return ld.superstep_indices, ld.superstep_mask
+        # generic loaders (zmq slave jobs) provide one minibatch
+        return (np.asarray(ld.minibatch_indices.map_read())[None],
+                np.asarray(ld.minibatch_mask.map_read())[None])
+
     def run(self) -> None:
         ld = self.loader
-        ev = self.evaluator
         self._ensure_params()
-        indices = ld.minibatch_indices.unmap()
-        mask = ld.minibatch_mask.unmap()
+        if self._acc is None:
+            self._acc, self._conf = self._fresh_acc()
+        indices, mask = self._superstep_arrays()
+        k = indices.shape[0]
         dataset = ld.original_data.unmap()
         targets = self._target_store()
         if self.mesh is not None:
@@ -231,31 +322,32 @@ class FusedStepRunner(AcceleratedUnit):
             indices = jax.device_put(indices, self._batch_sharding)
             mask = jax.device_put(mask, self._batch_sharding)
         if ld.minibatch_class == TRAIN:
-            self._params, self._opt, m = self._train_step(
-                self._params, self._opt, dataset, targets, indices, mask,
-                np.asarray(self.lr_scales, np.float32),
-                self._rng_counter)
+            self._params, self._opt, self._acc, self._conf = \
+                self._train_step(
+                    self._params, self._opt, self._acc, self._conf,
+                    dataset, targets, indices, mask,
+                    np.asarray(self.lr_scales, np.float32),
+                    self._rng_counter)
             self._scatter_params(self._params, self._opt)
         else:
-            m, out = self._eval_step(self._params, dataset, targets,
-                                     indices, mask, self._rng_counter)
+            self._acc, self._conf, out = self._eval_step(
+                self._params, self._acc, self._conf, dataset, targets,
+                indices, mask, self._rng_counter)
             self.forwards[-1].output.devmem = out
-        self._rng_counter += 1
-        # Publish metrics through the evaluator's Vectors (device
-        # handles only — no sync; Decision sums lazily per class).
-        ev.n_err.devmem = m["n_err"]
-        ev.loss.devmem = m["loss_sum"]
-        ev.count.devmem = m["count"]
-        if "max_idx" in m:
-            ev.max_idx.devmem = m["max_idx"]
-        if "confusion" in m:
-            # keep device handles; fold into the host matrix once per
-            # class end (a sync per minibatch would stall the pipeline)
-            self._conf_handles.append(m["confusion"])
-            if bool(ld.class_ended) and ev.confusion:
-                for h in self._conf_handles:
-                    ev.confusion.mem += np.asarray(h)
-                self._conf_handles.clear()
+        self._rng_counter += k
+
+    # -- metric intake (Decision / zmq slave) --------------------------
+
+    def take_class_metrics(self) -> Tuple[float, float, float,
+                                          Optional[np.ndarray]]:
+        """(n_err, loss_sum, count, confusion) accumulated since the
+        last call — ONE small device fetch, then reset."""
+        if self._acc is None:
+            return 0.0, 0.0, 0.0, None
+        acc = np.asarray(self._acc)
+        conf = np.asarray(self._conf) if self._want_confusion() else None
+        self._acc, self._conf = self._fresh_acc()
+        return float(acc[0]), float(acc[1]), float(acc[2]), conf
 
     # -- zmq DCN compat mode (server.py / client.py) -------------------
 
@@ -294,6 +386,4 @@ class FusedStepRunner(AcceleratedUnit):
 
     def __getstate__(self) -> dict:
         self.sync_params_to_vectors()
-        d = super().__getstate__()
-        d["_conf_handles"] = []
-        return d
+        return super().__getstate__()

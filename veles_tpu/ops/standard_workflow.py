@@ -39,10 +39,14 @@ class StandardWorkflow(NNWorkflow):
                  decision_config: Optional[Dict[str, Any]] = None,
                  snapshotter_config: Optional[Dict[str, Any]] = None,
                  lr_adjust_config: Optional[Dict[str, Any]] = None,
+                 superstep: int = 8,
                  **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.loss_function = loss_function
         self.layers_config = layers or []
+        #: fused mode runs up to this many same-class minibatches per
+        #: device dispatch (lax.scan) — amortizes dispatch latency
+        self.superstep = max(1, superstep)
 
         self.repeater = Repeater(self, name="repeater")
         if loader is None:
@@ -215,6 +219,8 @@ class StandardWorkflow(NNWorkflow):
         """Classic per-unit graph (numpy golden path)."""
         self._clear_control_links()
         self.loader.host_fill_enabled = True
+        self.loader.superstep = 1
+        self.decision.metrics_source = None
         self.repeater.link_from(self.start_point)
         self.loader.link_from(self.repeater)
         prev = self.loader
@@ -240,9 +246,11 @@ class StandardWorkflow(NNWorkflow):
         self._wire_common_tail(prev)
 
     def wire_fused(self) -> None:
-        """Single fused jitted step per iteration (TPU path)."""
+        """Single fused jitted scan per iteration (TPU path)."""
         self._clear_control_links()
         self.loader.host_fill_enabled = False
+        self.loader.superstep = self.superstep
+        self.decision.metrics_source = self.fused
         self.repeater.link_from(self.start_point)
         self.loader.link_from(self.repeater)
         prev = self.loader
