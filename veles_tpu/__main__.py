@@ -51,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimize", default=None, metavar="POP:GEN",
                    help="GA-tune config values wrapped in Tune(...): "
                         "population size : generations (e.g. 8:5)")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run plus "
+                        "a per-layer FLOPs table into DIR")
     p.add_argument("--status-server", default=None,
                    help="POST per-epoch status to this web_status "
                         "dashboard (http://host:port)")
@@ -85,7 +88,7 @@ def main(argv=None) -> int:
         dp=args.dp, master_address=args.master_address,
         listen_address=args.listen_address, multihost=args.multihost,
         plotters=args.plotters, status_server=args.status_server,
-        verbose=args.verbose)
+        profile=args.profile, verbose=args.verbose)
 
     if args.dump_config:
         from veles_tpu.config import root

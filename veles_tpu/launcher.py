@@ -35,6 +35,7 @@ class Launcher(Logger):
                  multihost: bool = False,
                  plotters: bool = False,
                  status_server: Optional[str] = None,
+                 profile: Optional[str] = None,
                  verbose: bool = False,
                  **kwargs: Any) -> None:
         setup_logging(10 if verbose else 20)
@@ -46,6 +47,7 @@ class Launcher(Logger):
         self.workflow = None
         self.plotters = plotters
         self.status_server = status_server
+        self.profile_dir = profile
         prng.seed_all(seed)
         if multihost:
             import jax
@@ -100,18 +102,38 @@ class Launcher(Logger):
         self.workflow.initialize(device=self.device, **kwargs)
 
     def run(self) -> None:
-        if self.mode == "standalone":
-            self.workflow.run()
-        elif self.mode == "master":
-            from veles_tpu.server import MasterServer
-            MasterServer(self.workflow, self.listen_address).serve()
-        else:
-            from veles_tpu.client import SlaveClient
-            SlaveClient(self.workflow, self.master_address).serve()
+        from veles_tpu import profiling
+        with profiling.trace(self.profile_dir):
+            if self.mode == "standalone":
+                self.workflow.run()
+            elif self.mode == "master":
+                from veles_tpu.server import MasterServer
+                MasterServer(self.workflow, self.listen_address).serve()
+            else:
+                from veles_tpu.client import SlaveClient
+                SlaveClient(self.workflow, self.master_address).serve()
+        if self.profile_dir:
+            self._dump_flops_table()
 
     def stop(self) -> None:
         if self.workflow is not None:
             self.workflow.stop()
+
+    def _dump_flops_table(self) -> None:
+        """Write the analytic per-layer FLOPs/params table next to the
+        jax.profiler trace so the two can be read together."""
+        forwards = getattr(self.workflow, "forwards", None)
+        if not forwards:
+            return
+        import json
+        from veles_tpu import profiling
+        path = os.path.join(self.profile_dir, "flops_table.json")
+        with open(path, "w") as f:
+            json.dump({"layers": profiling.layer_flops_table(forwards),
+                       "total": profiling.model_flops_per_sample(
+                           forwards)}, f, indent=2)
+        self.info("profile: trace + flops_table.json in %s",
+                  self.profile_dir)
 
 
 def load_workflow_module(path: str):

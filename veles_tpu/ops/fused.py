@@ -67,6 +67,11 @@ class FusedStepRunner(AcceleratedUnit):
         #: per-GD lr multipliers (traced arg — lr_adjust writes these
         #: without triggering a retrace)
         self.lr_scales = [1.0] * len(self.gds)
+        #: cumulative samples dispatched (host-side mask sums), train
+        #: and eval separately — feed the end-of-run MFU report
+        #: (veles_tpu/profiling.py): train costs fwd+bwd, eval fwd only
+        self.processed_images = 0.0
+        self.processed_eval_images = 0.0
 
     _unpicklable = AcceleratedUnit._unpicklable + (
         "_train_step", "_eval_step", "_params", "_opt", "mesh",
@@ -312,6 +317,10 @@ class FusedStepRunner(AcceleratedUnit):
             self._acc, self._conf = self._fresh_acc()
         indices, mask = self._superstep_arrays()
         k = indices.shape[0]
+        if ld.minibatch_class == TRAIN:
+            self.processed_images += float(np.sum(mask))
+        else:
+            self.processed_eval_images += float(np.sum(mask))
         dataset = ld.original_data.unmap()
         targets = self._target_store()
         if self.mesh is not None:
@@ -387,3 +396,9 @@ class FusedStepRunner(AcceleratedUnit):
     def __getstate__(self) -> dict:
         self.sync_params_to_vectors()
         return super().__getstate__()
+
+    def __setstate__(self, state: dict) -> None:
+        super().__setstate__(state)
+        # attrs added after a snapshot was written must default
+        self.__dict__.setdefault("processed_images", 0.0)
+        self.__dict__.setdefault("processed_eval_images", 0.0)

@@ -17,6 +17,7 @@ with device compute.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Any, Optional
 
 from veles_tpu.mutable import Bool
@@ -44,6 +45,10 @@ class Workflow(Container):
         self.stopped = Bool(False)
         self.device = None
         self._max_firings = kwargs.get("max_firings", 10_000_000)
+        #: cumulative wall-clock seconds spent inside run() — unlike
+        #: per-unit run_time this brackets the async device work too,
+        #: because the loop's metric fetches (Decision) block on it
+        self.wall_time = 0.0
 
     # -- lifecycle -----------------------------------------------------
 
@@ -104,6 +109,7 @@ class Workflow(Container):
         """Fire the start point and drive the graph until stopped."""
         if not self._initialized:
             raise RuntimeError("workflow.run() before initialize()")
+        t_start = time.perf_counter()
         self.stopped.set(False)
         queue: collections.deque = collections.deque([self.start_point])
         firings = 0
@@ -123,6 +129,7 @@ class Workflow(Container):
                 succ.links_from[unit] = True
                 if succ.ready and not bool(succ.gate_block):
                     queue.append(succ)
+        self.wall_time += time.perf_counter() - t_start
         self.on_workflow_finished()
 
     def stop(self) -> None:
@@ -146,6 +153,10 @@ class Workflow(Container):
                       name, count, t, 100.0 * t / total)
 
     # -- snapshot support ---------------------------------------------
+
+    def __setstate__(self, state: dict) -> None:
+        super().__setstate__(state)
+        self.__dict__.setdefault("wall_time", 0.0)
 
     def generate_data_for_master(self) -> Any:
         return None
