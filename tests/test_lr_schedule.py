@@ -1,0 +1,80 @@
+"""Per-iteration LR schedules must advance per MINIBATCH in fused mode
+(superstep scan), not per loader firing — the fused trajectory must
+match the eager one exactly (round-1 VERDICT weak #8 / next #10)."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import JaxDevice
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+
+def build(policy_by):
+    prng.seed_all(4242)
+    train, valid, _ = synthetic_classification(
+        160, 40, (8, 8, 1), n_classes=4, seed=99)
+    gd = {"learning_rate": 0.1, "gradient_moment": 0.0}
+    return StandardWorkflow(
+        loader_factory=lambda w: ArrayLoader(
+            w, train=train, valid=valid, minibatch_size=20,
+            name="loader"),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": 3},
+        lr_adjust_config={"policy_name": "inv",
+                          "policy_kwargs": {"gamma": 0.3, "power": 1.0},
+                          "by": policy_by},
+        superstep=8,
+        name="lr_test")
+
+
+class TestPerIterationSchedule:
+    @pytest.mark.parametrize("by", ["iteration", "epoch"])
+    def test_fused_superstep_matches_eager(self, by):
+        """8 train minibatches/epoch -> fused mode runs the whole epoch
+        as ONE scan; with by='iteration' each scanned minibatch must see
+        its own lr, or the trajectories diverge."""
+        w_eager = build(by)
+        w_eager.initialize(device=JaxDevice(platform="cpu"),
+                           fused=False)
+        w_eager.run()
+
+        w_fused = build(by)
+        w_fused.initialize(device=JaxDevice(platform="cpu"))
+        assert w_fused.loader.superstep == 8
+        w_fused.run()
+
+        # the schedule consumed the same number of iterations
+        assert w_eager.lr_adjust._iteration == \
+            w_fused.lr_adjust._iteration == 24  # 3 epochs x 8
+        he = [h for h in w_eager.decision.history
+              if h["class"] == "validation"]
+        hf = [h for h in w_fused.decision.history
+              if h["class"] == "validation"]
+        assert len(he) == len(hf) == 3
+        for a, b in zip(he, hf):
+            assert abs(a["loss"] - b["loss"]) < 1e-5, (by, a, b)
+        for f_e, f_f in zip(w_eager.forwards, w_fused.forwards):
+            np.testing.assert_allclose(
+                np.asarray(f_e.weights.map_read()),
+                np.asarray(w_fused.fused._params[f_f.name]["weights"]),
+                atol=1e-5)
+
+    def test_lr_rates_row_mismatch_raises(self):
+        from veles_tpu.loader.base import TRAIN
+        w = build("iteration")
+        w.initialize(device=JaxDevice(platform="cpu"))
+        while True:  # the first loader firings are validation
+            w.loader.run()
+            if w.loader.minibatch_class == TRAIN:
+                break
+        w.fused.lr_rates = [[[0.1, 0.1]] * 2] * 3  # wrong row count
+        with pytest.raises(ValueError, match="superstep"):
+            w.fused.run()

@@ -109,6 +109,52 @@ class TestMasterSlave:
             [(h["epoch"], h["loss"]) for h in hist]
         assert np.isfinite(master_w.forwards[0].weights.map_read()).all()
 
+    def test_jax_device_master_forced_eager(self):
+        """A master workflow initialized on a jax device gets fused
+        wiring by default — MasterServer must force eager semantics
+        (metrics from evaluator Vectors, one minibatch per job) or
+        Decision sees all-zero metrics and 7/8 of the data is skipped
+        (round-1 ADVICE high #2)."""
+        addr = f"tcp://127.0.0.1:{free_port()}"
+        master_w = build_workflow()
+        master_w.initialize(device=JaxDevice(platform="cpu"))
+        assert master_w.decision.metrics_source is not None  # fused
+        sw = build_workflow()
+        sw.initialize(device=JaxDevice(platform="cpu"))
+
+        server = MasterServer(master_w, addr, job_timeout=30.0,
+                              linger_s=0.5)
+        c1 = SlaveClient(sw, addr, timeout_ms=30000)
+        mt = threading.Thread(target=server.serve, daemon=True)
+        t1 = threading.Thread(target=c1.serve, daemon=True)
+        mt.start()
+        t1.start()
+        mt.join(timeout=120)
+        assert not mt.is_alive(), "master did not finish"
+        t1.join(timeout=30)
+
+        # serve() reset the fused wiring leftovers
+        assert master_w.decision.metrics_source is None
+        assert master_w.loader.superstep == 1
+        # one job per minibatch: 2 epochs x (10 train + 4 valid)
+        assert server._applied == 28, server._applied
+        # and the metrics are real, matching the standalone trajectory
+        w_ref = build_workflow()
+        w_ref.initialize(device=JaxDevice(platform="cpu"))
+        w_ref.run()
+        h_ref, h_ms = valid_history(w_ref), valid_history(master_w)
+        assert len(h_ref) == len(h_ms) == 2
+        for a, b in zip(h_ref, h_ms):
+            assert a["loss"] > 0 and abs(a["loss"] - b["loss"]) < 1e-4
+
+    def test_numpy_slave_rejected_with_clear_error(self):
+        """ADVICE low: a slave without a fused runner must fail loudly
+        at construction, not AttributeError mid-serve."""
+        w = build_workflow()
+        w.initialize(device=NumpyDevice())
+        with pytest.raises(ValueError, match="jax backend"):
+            SlaveClient(w, "tcp://127.0.0.1:1")
+
     def test_zombie_slave_job_requeued_and_master_terminates(self):
         """Elasticity + liveness: a slave that takes a job and vanishes
         must not wedge the in-order application head (job requeued after

@@ -29,6 +29,13 @@ def _tree_sub(a: Dict[str, Dict[str, np.ndarray]],
 class SlaveClient(Logger):
     def __init__(self, workflow, master_address: str,
                  timeout_ms: int = 120000) -> None:
+        dev = getattr(workflow, "device", None)
+        if getattr(workflow, "fused", None) is None or dev is None \
+                or not getattr(dev, "is_jax", False):
+            raise ValueError(
+                "slave mode runs jobs through the fused jitted step, "
+                "which needs a jax device — initialize the workflow "
+                "with a jax backend (-b tpu/jax/cpu), not numpy")
         self.workflow = workflow
         self.master_address = master_address
         self.timeout_ms = timeout_ms
@@ -42,8 +49,8 @@ class SlaveClient(Logger):
         loader, fused = w.loader, w.fused
         loader.apply_data_from_master(job["loader"])
         fused.set_host_params(job["params"])
-        if job.get("lr_scales"):
-            fused.lr_scales = list(job["lr_scales"])
+        if job.get("lr_rates"):
+            fused.lr_rates = job["lr_rates"]
         fused.run()
         n_err, loss_sum, count, _ = fused.take_class_metrics()
         metrics = {"n_err": n_err, "loss_sum": loss_sum,

@@ -99,6 +99,14 @@ class Launcher(Logger):
             # the mesh device replaces the single-chip device: Vectors
             # upload replicated, the fused step jits sharded
             self.device = self.workflow_dp.install()
+        if self.mode == "master" and hasattr(self.workflow,
+                                             "wire_fused"):
+            # The master never computes minibatches: fused wiring would
+            # point Decision at a never-run FusedStepRunner (all-zero
+            # metrics) and superstep>1 would advance the loader k
+            # minibatches per issued job while shipping only the last
+            # one.  Master-side semantics must be eager.
+            kwargs.setdefault("fused", False)
         self.workflow.initialize(device=self.device, **kwargs)
 
     def run(self) -> None:
@@ -110,6 +118,11 @@ class Launcher(Logger):
                 from veles_tpu.server import MasterServer
                 MasterServer(self.workflow, self.listen_address).serve()
             else:
+                if not self.device.is_jax:
+                    raise ValueError(
+                        "slave mode computes jobs with the fused jitted "
+                        "step — use a jax backend (-b tpu/jax/cpu), "
+                        "not numpy")
                 from veles_tpu.client import SlaveClient
                 SlaveClient(self.workflow, self.master_address).serve()
         if self.profile_dir:

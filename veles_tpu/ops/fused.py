@@ -64,9 +64,15 @@ class FusedStepRunner(AcceleratedUnit):
         #: confusion accumulator, reset at each take_class_metrics()
         self._acc: Any = None
         self._conf: Any = None
-        #: per-GD lr multipliers (traced arg — lr_adjust writes these
-        #: without triggering a retrace)
-        self.lr_scales = [1.0] * len(self.gds)
+        #: per-minibatch ABSOLUTE learning rates, shape (k, n_gd, 2)
+        #: [(lr_weights, lr_bias)], written by LearningRateAdjust as a
+        #: traced argument (no retrace).  None = read the live gd unit
+        #: rates each firing (constant within the superstep).  Absolute
+        #: rates, not scales: the traced step must never bake a
+        #: schedule-mutated rate as its base (that made every scale
+        #: multiply the wrong constant once lr_adjust ran before the
+        #: first train dispatch).
+        self.lr_rates = None
         #: cumulative samples dispatched (host-side mask sums), train
         #: and eval separately — feed the end-of-run MFU report
         #: (veles_tpu/profiling.py): train costs fwd+bwd, eval fwd only
@@ -188,10 +194,13 @@ class FusedStepRunner(AcceleratedUnit):
                 conf = conf + m["confusion"]
             return acc, conf
 
-        def train_body(dataset, target_store, lr_scales):
+        def train_body(dataset, target_store):
             def body(carry, xs):
                 params, opt, acc, conf, rc = carry
-                indices, mask = xs
+                # lr is this minibatch's (n_gd, 2) row of absolute
+                # (weights, bias) rates — per-iteration schedules stay
+                # exact inside a superstep (round-1 VERDICT weak #8)
+                indices, mask, lr = xs
                 x, target = gather(dataset, target_store, indices)
                 cparams = cast(params)
                 out, residuals = forward_pass(cparams, x, rc, True)
@@ -210,7 +219,8 @@ class FusedStepRunner(AcceleratedUnit):
                     if grads:
                         p, v = gd.update_params(params[f.name], grads,
                                                 opt.get(gd.name, {}),
-                                                lr_scales[i])
+                                                rates=(lr[i, 0],
+                                                       lr[i, 1]))
                         new_params[f.name] = p
                         if gd.name in opt:
                             new_opt[gd.name] = v
@@ -220,11 +230,11 @@ class FusedStepRunner(AcceleratedUnit):
             return body
 
         def train_step(params, opt, acc, conf, dataset, target_store,
-                       indices, mask, lr_scales, rng_counter):
-            body = train_body(dataset, target_store, lr_scales)
+                       indices, mask, lr_rates, rng_counter):
+            body = train_body(dataset, target_store)
             (params, opt, acc, conf, _), _ = lax.scan(
                 body, (params, opt, acc, conf, rng_counter),
-                (indices, mask))
+                (indices, mask, lr_rates))
             return params, opt, acc, conf
 
         def eval_step(params, acc, conf, dataset, target_store,
@@ -335,8 +345,7 @@ class FusedStepRunner(AcceleratedUnit):
                 self._train_step(
                     self._params, self._opt, self._acc, self._conf,
                     dataset, targets, indices, mask,
-                    np.asarray(self.lr_scales, np.float32),
-                    self._rng_counter)
+                    self._lr_rates_array(k), self._rng_counter)
             self._scatter_params(self._params, self._opt)
         else:
             self._acc, self._conf, out = self._eval_step(
@@ -344,6 +353,28 @@ class FusedStepRunner(AcceleratedUnit):
                 indices, mask, self._rng_counter)
             self.forwards[-1].output.devmem = out
         self._rng_counter += k
+
+    def _lr_rates_array(self, k: int) -> np.ndarray:
+        """``lr_rates`` as the (k, n_gd, 2) scanned input.  With no
+        schedule installed, read the gd units' live rates (constant
+        within the superstep, mutable between firings without retrace);
+        a 3-D array (per-iteration schedule, written by
+        LearningRateAdjust) must match the superstep exactly."""
+        if self.lr_rates is None:
+            row = np.asarray(
+                [[gd.learning_rate, gd.learning_rate_bias]
+                 if gd is not None else [0.0, 0.0] for gd in self.gds],
+                np.float32)
+            return np.broadcast_to(row, (k,) + row.shape)
+        lr = np.asarray(self.lr_rates, np.float32)
+        if lr.ndim == 2:
+            return np.broadcast_to(lr, (k,) + lr.shape)
+        if lr.shape[0] != k:
+            raise ValueError(
+                f"lr_rates has {lr.shape[0]} rows but the superstep "
+                f"has {k} minibatches — the schedule and loader "
+                f"disagree")
+        return lr
 
     # -- metric intake (Decision / zmq slave) --------------------------
 
@@ -402,3 +433,5 @@ class FusedStepRunner(AcceleratedUnit):
         # attrs added after a snapshot was written must default
         self.__dict__.setdefault("processed_images", 0.0)
         self.__dict__.setdefault("processed_eval_images", 0.0)
+        self.__dict__.pop("lr_scales", None)  # pre-rename snapshots
+        self.__dict__.setdefault("lr_rates", None)

@@ -4,11 +4,12 @@ Reference parity: veles/znicz/lr_adjust.py — policies (fixed, step,
 exponential, inverse) applied to the GD units' learning rates as
 training progresses (BASELINE config #3 "CIFAR-10 ... LR policy").
 
-TPU adaptation: in fused mode the per-GD base rates are trace-time
-constants, so schedules flow through the runner's ``lr_scales`` vector
-— a traced argument, scale_i(t) = lr_i(t) / lr_i(0) — and no retrace
-ever happens.  In eager mode the unit writes absolute rates into the
-GD units directly, like the reference.
+TPU adaptation: in fused mode the schedule flows through the runner's
+``lr_rates`` — one (n_gd, 2) row of ABSOLUTE (weights, bias) rates per
+minibatch of the superstep, threaded through the scan as a traced
+argument — so per-iteration policies stay exact inside a superstep and
+no retrace ever happens.  In eager mode the unit also writes the rates
+into the GD units directly, like the reference.
 """
 
 from __future__ import annotations
@@ -95,14 +96,35 @@ class LearningRateAdjust(Unit):
         if self.loader is not None and \
                 self.loader.minibatch_class != TRAIN:
             return
-        t = self.loader.epoch_number if self.by == "epoch" \
-            else self._iteration
-        self._iteration += 1
-        scales = []
-        for gd, (base_w, base_b) in zip(self.gds, self._base_rates):
-            lr = self.policy(base_w, t)
-            gd.learning_rate = lr
-            gd.learning_rate_bias = self.policy(base_b, t)
-            scales.append(lr / base_w if base_w else 1.0)
+        # The loader may have grouped k same-class minibatches into one
+        # superstep firing; a per-iteration schedule must advance once
+        # per MINIBATCH, so emit one (n_gd, 2) absolute-rate row per
+        # minibatch — the fused scan threads them as a scanned input
+        # (eager mode always has k=1).
+        k = int(getattr(self.loader, "superstep_k", 1) or 1)
+        # by="epoch": the loader already advanced through the group, so
+        # when it crossed the epoch boundary (always on the group's
+        # LAST train minibatch — train is the last class) epoch_number
+        # is the NEW epoch; the first k-1 minibatches belong to the old
+        # one.  Reconstructing this keeps fused identical to eager,
+        # where only the last firing of an epoch sees the incremented
+        # number.
+        e = self.loader.epoch_number
+        ended = bool(self.loader.epoch_ended)
+
+        def t_of(j: int) -> int:
+            if self.by == "epoch":
+                return e - 1 if (ended and j < k - 1) else e
+            return self._iteration + j
+
+        rows = [[[self.policy(base_w, t_of(j)),
+                  self.policy(base_b, t_of(j))]
+                 for (base_w, base_b) in self._base_rates]
+                for j in range(k)]
+        self._iteration += k
+        # eager GD units (and snapshots) see the absolute rates of the
+        # LAST minibatch in the group
+        for gd, row in zip(self.gds, rows[-1]):
+            gd.learning_rate, gd.learning_rate_bias = row
         if self.fused is not None:
-            self.fused.lr_scales = scales
+            self.fused.lr_rates = rows

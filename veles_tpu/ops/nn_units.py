@@ -255,15 +255,19 @@ class GradientUnit(AcceleratedUnit):
     def update_params(self, params: Dict[str, Any],
                       grads: Dict[str, Any],
                       velocities: Dict[str, Any],
-                      lr_scale: Any = 1.0) -> Tuple[Dict[str, Any],
-                                                    Dict[str, Any]]:
+                      rates: Any = None) -> Tuple[Dict[str, Any],
+                                                  Dict[str, Any]]:
         """Pure xp-agnostic SGD(+momentum) update; returns (new_params,
-        new_velocities)."""
+        new_velocities).  ``rates=(lr_weights, lr_bias)`` overrides the
+        unit's own rates — the fused step threads per-minibatch rates
+        through the scan this way, so the trace never bakes a
+        schedule-mutated ``self.learning_rate``."""
         new_p, new_v = {}, {}
+        lr_w, lr_b = rates if rates is not None else (
+            self.learning_rate, self.learning_rate_bias)
         for pname, w in params.items():
             g = grads[pname]
-            lr = (self.learning_rate if pname == "weights"
-                  else self.learning_rate_bias) * lr_scale
+            lr = lr_w if pname == "weights" else lr_b
             wd = self.weight_decay if pname == "weights" \
                 else self.weight_decay_bias
             g = g + wd * w

@@ -104,11 +104,12 @@ class MasterServer(Logger):
                  "last_minibatch": bool(ld.last_minibatch),
                  "train_ended": bool(ld.train_ended),
                  "epoch_number": ld.epoch_number}
+        fused = getattr(self.workflow, "fused", None)
         payload = {"loader": ld.generate_data_for_slave(),
                    "flags": flags,
                    "params": self._canonical_params(),
-                   "lr_scales": list(self.workflow.fused.lr_scales)
-                   if getattr(self.workflow, "fused", None) else None}
+                   "lr_rates": fused.lr_rates
+                   if fused is not None else None}
         return payload
 
     # -- in-order application -----------------------------------------
@@ -179,6 +180,15 @@ class MasterServer(Logger):
 
         w = self.workflow
         w.loader.host_fill_enabled = False  # indices only on the master
+        # Defense in depth for workflows initialized outside Launcher:
+        # the master's job protocol is one minibatch per job and its
+        # metrics arrive from slaves through the evaluator Vectors —
+        # fused-mode loader grouping / metric routing must be off here.
+        w.loader.superstep = 1
+        if getattr(w.decision, "metrics_source", None) is not None:
+            self.warning("master workflow was wired fused; forcing "
+                         "eager metric intake (evaluator Vectors)")
+            w.decision.metrics_source = None
         decision = w.decision
         ctx = zmq.Context.instance()
         sock = ctx.socket(zmq.ROUTER)
