@@ -1,0 +1,32 @@
+import time, sys
+import numpy as np
+import bench
+from veles_tpu.backends import make_device
+
+def log(m):
+    print(m, flush=True)
+
+def measure(streaming, n_train=128*8, firings=8):
+    t0 = time.perf_counter()
+    w = bench.build(mb=128, n_train=n_train, image=(227,227,3), n_classes=1000)
+    log(f'build {time.perf_counter()-t0:.1f}s')
+    if streaming:
+        w.loader.max_resident_bytes = 0
+    device = make_device('auto')
+    t0 = time.perf_counter()
+    w.initialize(device=device)
+    log(f'init {time.perf_counter()-t0:.1f}s')
+    loader, fused = w.loader, w.fused
+    def fire():
+        loader.run(); fused.run()
+    t0 = time.perf_counter()
+    for _ in range(2): fire()
+    bench.sync_images(fused)
+    log(f'warmup+compile {time.perf_counter()-t0:.1f}s')
+    i0 = bench.sync_images(fused); t0 = time.perf_counter()
+    for _ in range(firings): fire()
+    i1 = bench.sync_images(fused); dt = time.perf_counter() - t0
+    return (i1 - i0) / dt
+
+r = measure(False); log(f'resident: {r:,.0f} img/s')
+s = measure(True); log(f'streaming: {s:,.0f} img/s  ratio {s/r:.2%}')

@@ -1,0 +1,92 @@
+"""Round-1 VERDICT next #8: loud op registry, idempotent multihost
+init, strict forge manifests."""
+
+import subprocess
+import sys
+
+import pytest
+
+
+class TestRegistryLoudness:
+    def test_all_families_registered(self):
+        from veles_tpu.ops.registry import forward_registry
+        for name in ("all2all", "all2all_tanh", "all2all_relu",
+                     "softmax", "conv", "conv_tanh", "conv_relu",
+                     "max_pooling", "avg_pooling", "stochastic_pooling",
+                     "activation_tanh", "activation_relu",
+                     "activation_sigmoid", "activation_log",
+                     "activation_strict_relu", "dropout", "norm",
+                     "deconv", "depooling"):
+            assert name in forward_registry, name
+
+    def test_broken_family_import_fails_loudly(self):
+        """A transitive ImportError inside an op family must fail AT
+        REGISTRY IMPORT with the family named, not surface later as
+        'unknown layer type' (round-1 VERDICT weak #5)."""
+        code = r"""
+import importlib.abc
+import sys
+
+class Block(importlib.abc.MetaPathFinder):
+    def find_spec(self, name, path, target=None):
+        if name == "veles_tpu.ops.lrn":
+            raise ImportError("synthetic lrn breakage")
+
+sys.meta_path.insert(0, Block())
+try:
+    import veles_tpu.ops.registry  # noqa
+except ImportError as e:
+    assert "lrn" in str(e) and "registry" in str(e) or \
+        "silently missing" in str(e), str(e)
+    print("LOUD_FAILURE_OK")
+else:
+    print("IMPORTED_SILENTLY")
+"""
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=120,
+                           cwd="/root/repo")
+        assert "LOUD_FAILURE_OK" in r.stdout, (r.stdout, r.stderr)
+
+
+class TestMultihostGuard:
+    def test_initialize_called_once(self, monkeypatch):
+        import jax
+
+        from veles_tpu import launcher
+        calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda *a, **k: calls.append(1))
+        monkeypatch.setattr(launcher, "_multihost_initialized", False)
+        launcher.init_multihost()
+        launcher.init_multihost()
+        assert calls == [1]
+
+
+class TestForgeStrictManifest:
+    def test_unmanifested_member_rejected(self, tmp_path):
+        """An archive member missing from the manifest's sha256 map
+        must abort the install (smuggled unverified code)."""
+        import io
+        import tarfile
+
+        from veles_tpu.forge import ForgePackage
+
+        wf = tmp_path / "wf.py"
+        wf.write_text("def run(launcher):\n    pass\n")
+        out = str(tmp_path / "pkg.vpkg")
+        ForgePackage.pack(out, "demo", str(wf), [], author="t")
+
+        # append a file that the manifest does not cover
+        evil = str(tmp_path / "evil.vpkg")
+        with tarfile.open(out, "r:gz") as src, \
+                tarfile.open(evil, "w:gz") as dst:
+            for m in src.getmembers():
+                dst.addfile(m, src.extractfile(m))
+            payload = b"import os\n"
+            info = tarfile.TarInfo("smuggled.py")
+            info.size = len(payload)
+            dst.addfile(info, io.BytesIO(payload))
+
+        with pytest.raises(ValueError, match="not listed in the "
+                                             "manifest"):
+            ForgePackage.install(evil, str(tmp_path / "store"))

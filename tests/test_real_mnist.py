@@ -1,0 +1,80 @@
+"""Real-file MNIST path end-to-end (round-1 VERDICT missing #3): IDX
+files written offline -> loader picks them over the synthetic
+stand-in -> training runs."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import datasets, prng
+from veles_tpu.backends import JaxDevice
+from veles_tpu.config import root
+
+
+@pytest.fixture
+def idx_dir(tmp_path):
+    base = datasets.generate_mnist_idx(str(tmp_path / "mnist"),
+                                       n_train=512, n_test=128)
+    # point the data dir at tmp (try_load_real_mnist reads
+    # <data_dir>/mnist)
+    old = root.common.get("data_dir") if "common" in root else None
+    root.common.data_dir = str(tmp_path)
+    yield base
+    root.common.data_dir = old
+
+
+class TestIdxRoundtrip:
+    def test_write_read(self, tmp_path):
+        arr = (np.random.default_rng(1).random((7, 5, 4)) * 255) \
+            .astype(np.uint8)
+        p = str(tmp_path / "a.idx")
+        datasets.write_idx(p, arr)
+        back = datasets._read_idx(p)
+        np.testing.assert_array_equal(arr, back)
+
+    def test_generator_idempotent(self, tmp_path):
+        base = datasets.generate_mnist_idx(str(tmp_path), n_train=16,
+                                           n_test=8)
+        import os
+        mtimes = {f: os.path.getmtime(os.path.join(base, f))
+                  for f in os.listdir(base)}
+        base2 = datasets.generate_mnist_idx(str(tmp_path), n_train=32,
+                                            n_test=8)
+        assert base2 == base
+        for f, t in mtimes.items():
+            assert os.path.getmtime(os.path.join(base, f)) == t
+
+
+class TestRealFileLoading:
+    def test_loader_prefers_real_files(self, idx_dir):
+        real = datasets.try_load_real_mnist()
+        assert real is not None
+        (tx, ty), (vx, vy) = real
+        assert tx.shape == (512, 28, 28, 1) and vx.shape[0] == 128
+        assert tx.dtype == np.float32 and 0.0 <= tx.min() \
+            and tx.max() <= 1.0
+
+        from veles_tpu.loader.synthetic import MnistLoader
+        from veles_tpu.workflow import Workflow
+        w = Workflow(name="t")
+        ld = MnistLoader(w, name="loader", minibatch_size=64)
+        ld.initialize(device=None)
+        # real sizes, not the requested synthetic defaults
+        assert ld.class_lengths == [0, 128, 512]
+
+    def test_trains_on_real_files(self, idx_dir):
+        prng.seed_all(4321)
+        from veles_tpu.models import mnist
+
+        class FL:
+            workflow = None
+        w = mnist.create_workflow(
+            FL(), loader={"minibatch_size": 64},
+            decision={"max_epochs": 4})
+        w.initialize(device=JaxDevice(platform="cpu"))
+        assert w.loader.class_lengths == [0, 128, 512]
+        w.run()
+        hist = [h for h in w.decision.history
+                if h["class"] == "validation"]
+        assert len(hist) == 4
+        assert hist[-1]["error_pct"] < hist[0]["error_pct"] or \
+            hist[-1]["error_pct"] < 30.0

@@ -129,6 +129,16 @@ class ForgePackage(Logger):
                             or not (member.isfile() or member.isdir()):
                         raise ValueError(
                             f"unsafe member in package: {member.name!r}")
+                    # every extracted FILE must be covered by the
+                    # manifest's checksums — an unmanifested member
+                    # would install unverified (round-1 ADVICE low)
+                    if verify and member.isfile() \
+                            and mpath != "manifest.json" \
+                            and mpath not in manifest["sha256"]:
+                        raise ValueError(
+                            f"package member {member.name!r} is not "
+                            f"listed in the manifest checksums — "
+                            f"refusing to install unverified content")
                 try:
                     tar.extractall(staging, filter="data")
                 except TypeError:  # pre-3.12 tarfile without filter=

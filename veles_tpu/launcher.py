@@ -50,8 +50,7 @@ class Launcher(Logger):
         self.profile_dir = profile
         prng.seed_all(seed)
         if multihost:
-            import jax
-            jax.distributed.initialize()
+            init_multihost()
         self.device: Device = make_device(backend)
         self.info("launcher: backend=%s device=%r mode=%s",
                   backend, self.device, self.mode)
@@ -147,6 +146,31 @@ class Launcher(Logger):
                            forwards)}, f, indent=2)
         self.info("profile: trace + flops_table.json in %s",
                   self.profile_dir)
+
+
+_multihost_initialized = False
+
+
+def init_multihost() -> None:
+    """``jax.distributed.initialize()`` exactly once per process.
+
+    Multi-host SPMD launch recipe (SURVEY.md §5.8): start the SAME
+    ``python -m veles_tpu --multihost ...`` command on every host of
+    the slice; on TPU pods coordinator address/process id/count are
+    discovered from the TPU metadata automatically, elsewhere set
+    JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID / JAX_NUM_PROCESSES.
+    After initialization ``jax.devices()`` spans the whole slice, so a
+    ``--dp N_total`` mesh shards over every chip; DCN carries control,
+    ICI the collectives."""
+    global _multihost_initialized
+    if _multihost_initialized:
+        return
+    import jax
+    if jax.process_count() > 1:  # someone already initialized
+        _multihost_initialized = True
+        return
+    jax.distributed.initialize()
+    _multihost_initialized = True
 
 
 def load_workflow_module(path: str):

@@ -34,6 +34,12 @@ class FullBatchLoader(Loader):
         #: regression targets (MSE workflows) — may stay empty
         self.original_targets = Vector(name="original_targets")
         self.on_device = kwargs.get("on_device", True)
+        #: HBM residency budget for the dataset (bytes).  Datasets over
+        #: budget switch to the streaming path: host arrays stay, the
+        #: fused step consumes prefetched superstep batches instead of
+        #: gathering from an HBM-resident copy.  Overridable per loader
+        #: or via $VELES_MAX_RESIDENT_BYTES; default 8 GiB.
+        self.max_resident_bytes = kwargs.get("max_resident_bytes", None)
         #: input normalization (reference: loaders own a Normalizer,
         #: veles/normalization.py) — fitted on the TRAIN split once,
         #: state rides in snapshots so resume does not refit
@@ -85,13 +91,30 @@ class FullBatchLoader(Loader):
                 d[key] = vec
         return d
 
+    def _resident_budget(self) -> int:
+        if self.max_resident_bytes is not None:
+            return int(self.max_resident_bytes)
+        import os
+        return int(os.environ.get("VELES_MAX_RESIDENT_BYTES",
+                                  8 << 30))
+
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
+        if self.original_data and self.original_data.mem is not None \
+                and self.original_data.mem.nbytes > \
+                self._resident_budget():
+            self.device_resident = False
+            self.info("dataset %.1f GiB exceeds the %.1f GiB HBM "
+                      "residency budget — streaming superstep batches "
+                      "from host",
+                      self.original_data.mem.nbytes / 2 ** 30,
+                      self._resident_budget() / 2 ** 30)
+        resident = self.on_device and self.device_resident
         for v in (self.original_data, self.original_labels,
                   self.original_targets):
             if v:
-                v.initialize(device if self.on_device else None)
-                if device is not None and device.is_jax and self.on_device:
+                v.initialize(device if resident else None)
+                if device is not None and device.is_jax and resident:
                     v.unmap()  # one-time HBM upload
 
     def create_minibatch_data(self) -> None:
@@ -121,6 +144,16 @@ class FullBatchLoader(Loader):
         if self.has_targets:
             self.minibatch_targets.map_invalidate()[:] = \
                 self.original_targets.mem[idx]
+
+    def assemble_rows(self, indices: np.ndarray):
+        """Streaming-mode assembly: slice the host arrays (already
+        normalized by post_load_data)."""
+        data = self.original_data.mem[indices]
+        labels = self.original_labels.mem[indices] \
+            if self.has_labels else None
+        targets = self.original_targets.mem[indices] \
+            if self.has_targets else None
+        return data, labels, targets
 
 
 class ArrayLoader(FullBatchLoader):

@@ -49,7 +49,15 @@ def decode_image(path: str, target_shape: Tuple[int, int, int],
 
 
 class FileListImageLoader(FullBatchLoader):
-    """Loader over explicit per-split ``[(path, label), ...]`` lists."""
+    """Loader over explicit per-split ``[(path, label), ...]`` lists.
+
+    ``streaming="auto"`` (default): when the decoded dataset would
+    exceed the residency budget, nothing is pre-decoded — the loader
+    keeps only the path list and decodes each superstep's files on the
+    prefetch thread (a decode pool fans the PIL work out over cores).
+    This is the ImageNet-scale path: dataset size is bounded by disk,
+    not by HBM or host RAM.  ``streaming=True``/``False`` forces the
+    mode."""
 
     def __init__(self, workflow=None,
                  train: Optional[Sequence[Tuple[str, int]]] = None,
@@ -57,6 +65,9 @@ class FileListImageLoader(FullBatchLoader):
                  test: Optional[Sequence[Tuple[str, int]]] = None,
                  target_shape: Tuple[int, int, int] = (32, 32, 3),
                  normalize: bool = True,
+                 streaming: Any = "auto",
+                 decode_workers: int = 0,
+                 norm_sample: int = 512,
                  **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.file_lists = {TRAIN: list(train or ()),
@@ -64,21 +75,121 @@ class FileListImageLoader(FullBatchLoader):
                            TEST: list(test or ())}
         self.target_shape = tuple(target_shape)
         self.normalize = normalize
+        self.streaming = streaming
+        self.decode_workers = decode_workers  # 0 = cpu count (cap 16)
+        self.norm_sample = norm_sample
+        self._paths: List[str] = []
+        self._stream = False
+        self._decode_pool = None
+
+    _unpicklable = FullBatchLoader._unpicklable + ("_decode_pool",)
+
+    def _flat_entries(self) -> List[Tuple[str, int]]:
+        """All (path, label) laid out [test | valid | train] to match
+        the global sample indexing."""
+        out: List[Tuple[str, int]] = []
+        for klass in (TEST, VALID, TRAIN):
+            out.extend(self.file_lists[klass])
+        return out
 
     def load_data(self) -> None:
-        xs: List[np.ndarray] = []
-        ys: List[int] = []
-        for klass in (TEST, VALID, TRAIN):
-            entries = self.file_lists[klass]
-            self.class_lengths[klass] = len(entries)
-            for path, label in entries:
-                xs.append(decode_image(path, self.target_shape,
-                                       self.normalize))
-                ys.append(int(label))
-        if not xs:
+        entries = self._flat_entries()
+        if not entries:
             raise ValueError(f"{self.name}: no image files")
-        self.original_data.mem = np.stack(xs)
-        self.original_labels.mem = np.asarray(ys, np.int32)
+        for klass in (TEST, VALID, TRAIN):
+            self.class_lengths[klass] = len(self.file_lists[klass])
+        self._paths = [p for p, _ in entries]
+        self.original_labels.mem = np.asarray(
+            [l for _, l in entries], np.int32)
+        est_bytes = len(entries) * \
+            int(np.prod(self.target_shape)) * 4
+        self._stream = self.streaming is True or (
+            self.streaming == "auto" and
+            est_bytes > self._resident_budget())
+        if self._stream:
+            self.device_resident = False
+            self.info("%d images (~%.1f GiB decoded) stream from disk;"
+                      " decode on the prefetch path",
+                      len(entries), est_bytes / 2 ** 30)
+            return
+        self.original_data.mem = self._decode_batch(
+            np.arange(len(entries)))
+
+    # -- decoding ------------------------------------------------------
+
+    def _decode_one(self, i: int) -> np.ndarray:
+        return decode_image(self._paths[i], self.target_shape,
+                            self.normalize)
+
+    def _decode_batch(self, indices: np.ndarray) -> np.ndarray:
+        """Decode rows for global ``indices``, fanning PIL decodes out
+        over a thread pool (PIL releases the GIL around the codec)."""
+        indices = np.asarray(indices)
+        if len(indices) <= 4:
+            return np.stack([self._decode_one(i) for i in indices])
+        if self._decode_pool is None:
+            import os as _os
+            from concurrent.futures import ThreadPoolExecutor
+            n = self.decode_workers or min(_os.cpu_count() or 4, 16)
+            self._decode_pool = ThreadPoolExecutor(
+                n, thread_name_prefix=f"{self.name}-decode")
+        return np.stack(list(self._decode_pool.map(self._decode_one,
+                                                   indices)))
+
+    def assemble_rows(self, indices: np.ndarray):
+        data = self._decode_batch(indices)
+        if self.normalizer is not None:
+            data = self.normalizer.apply(data)
+        return data, self.original_labels.mem[indices], None
+
+    def fill_minibatch(self) -> None:
+        if not self._stream:
+            super().fill_minibatch()
+            return
+        idx = self.minibatch_indices.map_read()
+        data, labels, _ = self.assemble_rows(idx)
+        self.minibatch_data.map_invalidate()[:] = data
+        self.minibatch_labels.map_invalidate()[:] = labels
+
+    # -- streaming-mode hooks ------------------------------------------
+
+    def post_load_data(self) -> None:
+        if not self._stream:
+            super().post_load_data()
+            return
+        if self.normalization_type == "none" and self.normalizer is None:
+            return
+        # fit the normalizer on a bounded sample of TRAIN files — the
+        # full set cannot be materialized by definition here
+        from veles_tpu.normalization import make_normalizer
+        if self.normalizer is None:
+            n_train = self.class_lengths[TRAIN]
+            if n_train == 0:
+                raise ValueError(
+                    f"{self.name}: normalization needs a TRAIN split")
+            off = self.class_offset(TRAIN)
+            sample = np.arange(off, off + min(n_train, self.norm_sample))
+            self.normalizer = make_normalizer(
+                self.normalization_type,
+                **self.normalization_parameters)
+            self.normalizer.fit(self._decode_batch(sample))
+
+    def create_minibatch_data(self) -> None:
+        if not self._stream:
+            super().create_minibatch_data()
+            return
+        mb = self.max_minibatch_size
+        self.minibatch_data.mem = np.zeros(
+            (mb,) + self.target_shape, np.float32)
+        self.minibatch_labels.mem = np.zeros(mb, np.int32)
+        for v in (self.minibatch_data, self.minibatch_labels):
+            v.initialize(self.device)
+
+    def stop(self) -> None:
+        if self._decode_pool is not None:
+            self._decode_pool.shutdown(wait=False)
+            self._decode_pool = None
+        super().stop()
 
     def __getstate__(self) -> dict:
         # decoded pixels are regenerable from the file lists — drop the

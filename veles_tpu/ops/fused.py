@@ -60,6 +60,10 @@ class FusedStepRunner(AcceleratedUnit):
         self._params: Optional[Dict[str, Dict[str, Any]]] = None
         self._opt: Optional[Dict[str, Dict[str, Any]]] = None
         self._rng_counter = 0
+        #: True = the loader's dataset is not HBM-resident; the step
+        #: consumes host-assembled superstep batches (resolved at
+        #: initialize from loader.device_resident)
+        self.streaming = False
         #: on-device metric accumulator [n_err, loss_sum, count] and
         #: confusion accumulator, reset at each take_class_metrics()
         self._acc: Any = None
@@ -149,6 +153,7 @@ class FusedStepRunner(AcceleratedUnit):
         cd = self._resolved_dtype()
         mixed = cd != jnp.float32
         out_shape = tuple(forwards[-1].output.shape)
+        streaming = self.streaming
 
         def cast(tree):
             if not mixed:
@@ -200,8 +205,13 @@ class FusedStepRunner(AcceleratedUnit):
                 # lr is this minibatch's (n_gd, 2) row of absolute
                 # (weights, bias) rates — per-iteration schedules stay
                 # exact inside a superstep (round-1 VERDICT weak #8)
-                indices, mask, lr = xs
-                x, target = gather(dataset, target_store, indices)
+                if streaming:
+                    # host-assembled batch rows ride the scan directly;
+                    # no HBM-resident dataset exists to gather from
+                    x, target, mask, lr = xs
+                else:
+                    indices, mask, lr = xs
+                    x, target = gather(dataset, target_store, indices)
                 cparams = cast(params)
                 out, residuals = forward_pass(cparams, x, rc, True)
                 m = metrics_of(out, target, mask)
@@ -237,6 +247,14 @@ class FusedStepRunner(AcceleratedUnit):
                 (indices, mask, lr_rates))
             return params, opt, acc, conf
 
+        def train_step_stream(params, opt, acc, conf, xb, tb, mask,
+                              lr_rates, rng_counter):
+            body = train_body(None, None)
+            (params, opt, acc, conf, _), _ = lax.scan(
+                body, (params, opt, acc, conf, rng_counter),
+                (xb, tb, mask, lr_rates))
+            return params, opt, acc, conf
+
         def eval_step(params, acc, conf, dataset, target_store,
                       indices, mask, rng_counter):
             cparams = cast(params)
@@ -257,6 +275,25 @@ class FusedStepRunner(AcceleratedUnit):
                 (indices, mask))
             return acc, conf, out
 
+        def eval_step_stream(params, acc, conf, xb, tb, mask,
+                             rng_counter):
+            cparams = cast(params)
+
+            def body(carry, xs):
+                acc, conf, _, rc = carry
+                x, target, mask = xs
+                out, _ = forward_pass(cparams, x, rc, False)
+                m = metrics_of(out, target, mask)
+                m.pop("err_output")
+                acc, conf = accumulate(acc, conf, m)
+                return (acc, conf, out.astype(jnp.float32), rc + 1), None
+
+            init_out = jnp.zeros(out_shape, jnp.float32)
+            (acc, conf, out, _), _ = lax.scan(
+                body, (acc, conf, init_out, rng_counter),
+                (xb, tb, mask))
+            return acc, conf, out
+
         if self.mesh is not None:
             # SPMD data parallelism: minibatch rows sharded over the
             # data axis, params/dataset replicated.  mask.sum() and the
@@ -266,18 +303,35 @@ class FusedStepRunner(AcceleratedUnit):
             import jax.sharding as shd
             from veles_tpu.parallel.mesh import replicated_sharding
             repl = replicated_sharding(self.mesh)
-            # superstep batches are (k, mb): shard the MINIBATCH axis
+            # superstep batches are (k, mb, ...): shard the MINIBATCH
+            # axis (streaming batch rows ride the same sharding — each
+            # device receives only its slice of every minibatch)
             batch = self._batch_sharding = shd.NamedSharding(
                 self.mesh,
                 shd.PartitionSpec(None, self.mesh.axis_names[0]))
-            self._train_step = jax.jit(
-                train_step, donate_argnums=(0, 1, 2, 3),
-                in_shardings=(repl, repl, repl, repl, repl, repl,
-                              batch, batch, repl, repl))
-            self._eval_step = jax.jit(
-                eval_step, donate_argnums=(1, 2),
-                in_shardings=(repl, repl, repl, repl, repl,
-                              batch, batch, repl))
+            if streaming:
+                self._train_step = jax.jit(
+                    train_step_stream, donate_argnums=(0, 1, 2, 3),
+                    in_shardings=(repl, repl, repl, repl, batch,
+                                  batch, batch, repl, repl))
+                self._eval_step = jax.jit(
+                    eval_step_stream, donate_argnums=(1, 2),
+                    in_shardings=(repl, repl, repl, batch, batch,
+                                  batch, repl))
+            else:
+                self._train_step = jax.jit(
+                    train_step, donate_argnums=(0, 1, 2, 3),
+                    in_shardings=(repl, repl, repl, repl, repl, repl,
+                                  batch, batch, repl, repl))
+                self._eval_step = jax.jit(
+                    eval_step, donate_argnums=(1, 2),
+                    in_shardings=(repl, repl, repl, repl, repl,
+                                  batch, batch, repl))
+        elif streaming:
+            self._train_step = jax.jit(train_step_stream,
+                                       donate_argnums=(0, 1, 2, 3))
+            self._eval_step = jax.jit(eval_step_stream,
+                                      donate_argnums=(1, 2))
         else:
             self._train_step = jax.jit(train_step,
                                        donate_argnums=(0, 1, 2, 3))
@@ -287,6 +341,13 @@ class FusedStepRunner(AcceleratedUnit):
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
+        if not any(self.loader.class_lengths):
+            # Workflow.initialize retries on AttributeError — the
+            # loader must load first so its residency mode is known
+            raise AttributeError(
+                f"{self.name}: loader has not loaded its data yet")
+        self.streaming = not getattr(self.loader, "device_resident",
+                                     True)
         if self.mesh is not None:
             # the STATIC minibatch shape is max_minibatch_size, which
             # clamps below minibatch_size when every class is smaller —
@@ -327,10 +388,18 @@ class FusedStepRunner(AcceleratedUnit):
             self._acc, self._conf = self._fresh_acc()
         indices, mask = self._superstep_arrays()
         k = indices.shape[0]
-        if ld.minibatch_class == TRAIN:
+        train = ld.minibatch_class == TRAIN
+        if train:
             self.processed_images += float(np.sum(mask))
         else:
             self.processed_eval_images += float(np.sum(mask))
+        if self.streaming:
+            self._run_streaming(ld, k, mask, train)
+        else:
+            self._run_resident(ld, k, indices, mask, train)
+        self._rng_counter += k
+
+    def _run_resident(self, ld, k, indices, mask, train: bool) -> None:
         dataset = ld.original_data.unmap()
         targets = self._target_store()
         if self.mesh is not None:
@@ -340,7 +409,7 @@ class FusedStepRunner(AcceleratedUnit):
             import jax
             indices = jax.device_put(indices, self._batch_sharding)
             mask = jax.device_put(mask, self._batch_sharding)
-        if ld.minibatch_class == TRAIN:
+        if train:
             self._params, self._opt, self._acc, self._conf = \
                 self._train_step(
                     self._params, self._opt, self._acc, self._conf,
@@ -352,7 +421,37 @@ class FusedStepRunner(AcceleratedUnit):
                 self._params, self._acc, self._conf, dataset, targets,
                 indices, mask, self._rng_counter)
             self.forwards[-1].output.devmem = out
-        self._rng_counter += k
+
+    def _run_streaming(self, ld, k, mask, train: bool) -> None:
+        """Dispatch over the loader's host-assembled superstep batch.
+        The dispatch is async: while the device chews on this group the
+        loader's prefetch thread is already assembling the next one —
+        that concurrency IS the input pipeline (no resident dataset)."""
+        xb = ld.superstep_data
+        tb = ld.superstep_targets if self._has_targets() \
+            else ld.superstep_labels
+        if xb is None or tb is None:
+            raise RuntimeError(
+                f"{self.name}: streaming mode but the loader produced "
+                f"no superstep batch (superstep_data/"
+                f"{'targets' if self._has_targets() else 'labels'})")
+        if self.mesh is not None:
+            import jax
+            xb = jax.device_put(xb, self._batch_sharding)
+            tb = jax.device_put(tb, self._batch_sharding)
+            mask = jax.device_put(mask, self._batch_sharding)
+        if train:
+            self._params, self._opt, self._acc, self._conf = \
+                self._train_step(
+                    self._params, self._opt, self._acc, self._conf,
+                    xb, tb, mask, self._lr_rates_array(k),
+                    self._rng_counter)
+            self._scatter_params(self._params, self._opt)
+        else:
+            self._acc, self._conf, out = self._eval_step(
+                self._params, self._acc, self._conf, xb, tb, mask,
+                self._rng_counter)
+            self.forwards[-1].output.devmem = out
 
     def _lr_rates_array(self, k: int) -> np.ndarray:
         """``lr_rates`` as the (k, n_gd, 2) scanned input.  With no
@@ -435,3 +534,4 @@ class FusedStepRunner(AcceleratedUnit):
         self.__dict__.setdefault("processed_eval_images", 0.0)
         self.__dict__.pop("lr_scales", None)  # pre-rename snapshots
         self.__dict__.setdefault("lr_rates", None)
+        self.__dict__.setdefault("streaming", False)

@@ -21,53 +21,83 @@ def gd_for(name: str) -> type:
 
 
 def _populate() -> None:
-    from veles_tpu.ops import all2all
-    register("all2all", all2all.All2All, all2all.GradientDescent)
-    register("all2all_tanh", all2all.All2AllTanh, all2all.GDTanh)
-    register("all2all_relu", all2all.All2AllRELU, all2all.GDRELU)
-    register("softmax", all2all.All2AllSoftmax, all2all.GDSoftmax)
-    try:
+    """Import every op family and register its layer types.  A broken
+    import fails HERE, loudly, with the family named — a silently
+    missing family would otherwise surface as a baffling "unknown
+    layer type" far from the real cause (round-1 VERDICT weak #5)."""
+    families = []
+
+    def family(name: str):
+        def deco(fn):
+            families.append((name, fn))
+            return fn
+        return deco
+
+    @family("all2all")
+    def _all2all():
+        from veles_tpu.ops import all2all
+        register("all2all", all2all.All2All, all2all.GradientDescent)
+        register("all2all_tanh", all2all.All2AllTanh, all2all.GDTanh)
+        register("all2all_relu", all2all.All2AllRELU, all2all.GDRELU)
+        register("softmax", all2all.All2AllSoftmax, all2all.GDSoftmax)
+
+    @family("conv")
+    def _conv():
         from veles_tpu.ops import conv as conv_mod
         register("conv", conv_mod.Conv, conv_mod.GradientDescentConv)
-        register("conv_tanh", conv_mod.ConvTanh, conv_mod.GradientDescentConv)
-        register("conv_relu", conv_mod.ConvRELU, conv_mod.GradientDescentConv)
-    except ImportError:
-        pass
-    try:
+        register("conv_tanh", conv_mod.ConvTanh,
+                 conv_mod.GradientDescentConv)
+        register("conv_relu", conv_mod.ConvRELU,
+                 conv_mod.GradientDescentConv)
+
+    @family("pooling")
+    def _pooling():
         from veles_tpu.ops import pooling
-        register("max_pooling", pooling.MaxPooling, pooling.GDMaxPooling)
-        register("avg_pooling", pooling.AvgPooling, pooling.GDAvgPooling)
+        register("max_pooling", pooling.MaxPooling,
+                 pooling.GDMaxPooling)
+        register("avg_pooling", pooling.AvgPooling,
+                 pooling.GDAvgPooling)
         register("stochastic_pooling", pooling.StochasticPooling,
                  pooling.GDMaxPooling)
-    except ImportError:
-        pass
-    try:
+
+    @family("activation")
+    def _activation():
         from veles_tpu.ops import activation as act
-        register("activation_tanh", act.ActivationTanh, act.GDActivation)
-        register("activation_relu", act.ActivationRELU, act.GDActivation)
+        register("activation_tanh", act.ActivationTanh,
+                 act.GDActivation)
+        register("activation_relu", act.ActivationRELU,
+                 act.GDActivation)
         register("activation_sigmoid", act.ActivationSigmoid,
                  act.GDActivation)
         register("activation_log", act.ActivationLog, act.GDActivation)
         register("activation_strict_relu", act.ActivationStrictRELU,
                  act.GDActivation)
-    except ImportError:
-        pass
-    try:
+
+    @family("dropout")
+    def _dropout():
         from veles_tpu.ops import dropout
         register("dropout", dropout.Dropout, dropout.GDDropout)
-    except ImportError:
-        pass
-    try:
+
+    @family("lrn")
+    def _lrn():
         from veles_tpu.ops import lrn
         register("norm", lrn.LRNormalizer, lrn.GDLRNormalizer)
-    except ImportError:
-        pass
-    try:
+
+    @family("deconv/depooling")
+    def _deconv():
         from veles_tpu.ops import deconv, depooling
         register("deconv", deconv.Deconv, deconv.GradientDescentDeconv)
-        register("depooling", depooling.Depooling, depooling.GDDepooling)
-    except ImportError:
-        pass
+        register("depooling", depooling.Depooling,
+                 depooling.GDDepooling)
+
+    for name, fn in families:
+        try:
+            fn()
+        except ImportError as e:
+            raise ImportError(
+                f"op family {name!r} failed to import — its layer "
+                f"types would be silently missing from the registry: "
+                f"{e}") from e
 
 
 _populate()
