@@ -58,9 +58,12 @@ def build(mb, n_train, image, n_classes):
 
 def sync_images(fused) -> float:
     """Force a device->host fetch of the step-dependent metric carry
-    and return the cumulative processed-sample count it holds."""
-    acc = np.asarray(fused._acc)
-    return float(acc[2])
+    (the honest barrier) and return the cumulative processed-sample
+    count.  The count comes from the host-side float64
+    ``processed_images`` counter, not the float32 on-device carry,
+    which silently loses integer precision past 2^24 images."""
+    np.asarray(fused._acc)  # data-dependent sync barrier only
+    return float(fused.processed_images)
 
 
 def secondary_metric():

@@ -61,6 +61,56 @@ class TestMultihostGuard:
         launcher.init_multihost()
         assert calls == [1]
 
+    def test_no_backend_touch_before_initialize(self, monkeypatch):
+        """Round-2 advisor high: jax.process_count() initializes the
+        XLA backend, after which distributed.initialize() always
+        raises.  init_multihost must never call it (or jax.devices)
+        before initialize."""
+        import jax
+
+        from veles_tpu import launcher
+
+        def boom(*a, **k):
+            raise AssertionError("backend touched before initialize")
+        monkeypatch.setattr(jax, "process_count", boom)
+        monkeypatch.setattr(jax, "devices", boom)
+        calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda *a, **k: calls.append(1))
+        monkeypatch.setattr(launcher, "_multihost_initialized", False)
+        launcher.init_multihost()
+        assert calls == [1]
+
+    def test_already_initialized_client_detected(self, monkeypatch):
+        """When the distributed client already exists, initialize()
+        must not be called again."""
+        from jax._src import distributed
+
+        from veles_tpu import launcher
+        monkeypatch.setattr(distributed.global_state, "client",
+                            object(), raising=False)
+        calls = []
+        import jax
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda *a, **k: calls.append(1))
+        monkeypatch.setattr(launcher, "_multihost_initialized", False)
+        launcher.init_multihost()
+        assert calls == []
+
+    def test_refused_initialize_warns_not_crashes(self, monkeypatch):
+        """A RuntimeError from initialize (backend already up) must be
+        survivable — warn and continue single-process."""
+        import jax
+
+        from veles_tpu import launcher
+
+        def refuse(*a, **k):
+            raise RuntimeError("must be called before any JAX calls")
+        monkeypatch.setattr(jax.distributed, "initialize", refuse)
+        monkeypatch.setattr(launcher, "_multihost_initialized", False)
+        launcher.init_multihost()  # must not raise
+        assert launcher._multihost_initialized
+
 
 class TestForgeStrictManifest:
     def test_unmanifested_member_rejected(self, tmp_path):

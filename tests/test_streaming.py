@@ -224,3 +224,27 @@ class TestStreamingImages:
         assert big.original_data.mem is None
         big.run()
         assert float(np.abs(big.minibatch_data.map_read()).sum()) > 0
+
+    def test_forced_resident_over_budget_does_not_redecode(
+            self, tmp_path):
+        """Round-2 advisor low: streaming=False + dataset over the HBM
+        budget flips device_resident off; assemble_rows must then slice
+        the already-decoded host pixels, not hit the disk again."""
+        make_image_tree(str(tmp_path), per_class=4)
+        from veles_tpu.workflow import Workflow
+        w = Workflow(name="t")
+        ld = ImageDirectoryLoader(w, name="l",
+                                  data_dir=str(tmp_path),
+                                  target_shape=(12, 12, 1),
+                                  minibatch_size=6,
+                                  streaming=False,
+                                  max_resident_bytes=100)
+        ld.initialize(device=None)
+        assert not ld.device_resident       # over budget
+        assert ld.original_data.mem is not None  # but decoded upfront
+        decodes = []
+        orig = ld._decode_one
+        ld._decode_one = lambda i: decodes.append(i) or orig(i)
+        rows, labels, _ = ld.assemble_rows(np.arange(4))
+        assert decodes == []                # sliced, not re-decoded
+        np.testing.assert_array_equal(rows, ld.original_data.mem[:4])

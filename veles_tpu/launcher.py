@@ -166,10 +166,26 @@ def init_multihost() -> None:
     if _multihost_initialized:
         return
     import jax
-    if jax.process_count() > 1:  # someone already initialized
-        _multihost_initialized = True
-        return
-    jax.distributed.initialize()
+    # Detect prior initialization WITHOUT touching the backend:
+    # jax.process_count() would itself initialize XLA, after which
+    # distributed.initialize() unconditionally raises.  The distributed
+    # client handle is the only side-effect-free signal.
+    try:
+        from jax._src.distributed import global_state
+        already = global_state.client is not None
+    except Exception:
+        already = False
+    if not already:
+        try:
+            jax.distributed.initialize()
+        except RuntimeError as e:
+            # Backend already up (e.g. the embedding process made a JAX
+            # call first) — single-process semantics are the only safe
+            # fallback; surface it loudly rather than crash.
+            import logging
+            logging.getLogger("veles_tpu.launcher").warning(
+                "jax.distributed.initialize() refused (%s); continuing "
+                "single-process", e)
     _multihost_initialized = True
 
 

@@ -137,6 +137,11 @@ class FileListImageLoader(FullBatchLoader):
                                                    indices)))
 
     def assemble_rows(self, indices: np.ndarray):
+        if self.original_data.mem is not None:
+            # decoded + normalized pixels are already resident on host
+            # (streaming=False but over the HBM budget) — slice them
+            # instead of re-decoding every superstep
+            return super().assemble_rows(indices)
         data = self._decode_batch(indices)
         if self.normalizer is not None:
             data = self.normalizer.apply(data)
