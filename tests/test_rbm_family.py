@@ -1,0 +1,226 @@
+"""RBM / cutter / resizable_all2all family (SURVEY.md §3.2 "RBM /
+other" — reconstructed from the survey description; the reference
+mount is empty).  Standard battery: numpy-vs-jax agreement, fd grad
+checks where a true gradient exists, an independent numpy CD-1 oracle
+for the RBM, and workflow-level convergence."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from test_ops import check_unit
+
+from veles_tpu import prng
+from veles_tpu.backends import JaxDevice, NumpyDevice
+from veles_tpu.ops import all2all as a2a_mod
+from veles_tpu.ops import cutter as cutter_mod
+from veles_tpu.ops import rbm as rbm_mod
+from veles_tpu.ops import resizable_all2all as ra2a_mod
+
+RNG = np.random.default_rng(7)
+
+
+@pytest.fixture(scope="module")
+def dev():
+    return JaxDevice(platform="cpu")
+
+
+class FakeLauncher:
+    workflow = None
+
+
+class TestCutter:
+    def test_battery(self):
+        u = cutter_mod.Cutter(padding=(1, 2, 1, 1))
+        check_unit(u, cutter_mod.GDCutter, (3, 8, 9, 2))
+
+    def test_shapes_and_values(self):
+        u = cutter_mod.Cutter(padding=(2, 1, 1, 3))
+        x = RNG.standard_normal((2, 10, 12, 3)).astype(np.float32)
+        y = u.apply({}, {"input": x})["output"]
+        assert y.shape == (2, 6, 9, 3)
+        np.testing.assert_array_equal(y, x[:, 1:7, 2:11])
+        assert u.output_shape_for((2, 10, 12, 3)) == (2, 6, 9, 3)
+
+    def test_overcut_rejected(self):
+        u = cutter_mod.Cutter(padding=(5, 5, 5, 5))
+        with pytest.raises(ValueError):
+            u.output_shape_for((1, 8, 8, 1))
+
+
+class TestAll2AllSigmoid:
+    def test_battery(self):
+        u = a2a_mod.All2AllSigmoid(output_sample_shape=6)
+        check_unit(u, a2a_mod.GDSigmoid, (5, 9))
+
+
+class TestResizableAll2All:
+    def test_battery(self):
+        u = ra2a_mod.ResizableAll2All(output_sample_shape=7)
+        check_unit(u, ra2a_mod.GDResizableAll2All, (4, 5))
+
+    def test_resize_preserves_learned_columns(self, dev):
+        u = ra2a_mod.ResizableAll2All(output_sample_shape=6)
+        u.input.mem = RNG.standard_normal((3, 4)).astype(np.float32)
+        u.initialize(device=dev)
+        w_before = np.array(u.weights.map_read())
+        b_before = np.array(u.bias.map_read())
+        u.resize(9)
+        assert u.weights.shape == (4, 9)
+        np.testing.assert_array_equal(u.weights.map_read()[:, :6],
+                                      w_before)
+        np.testing.assert_array_equal(u.bias.map_read()[:6], b_before)
+        u.resize(4)  # shrink keeps the prefix
+        np.testing.assert_array_equal(u.weights.map_read(),
+                                      w_before[:, :4])
+
+    def test_resize_mid_run_fused(self, dev):
+        """A resize between epochs must invalidate the fused trace and
+        keep training (explicit recompile, no stale-shape crash)."""
+        from veles_tpu.loader.synthetic import \
+            SyntheticClassificationLoader
+        from veles_tpu.ops.standard_workflow import StandardWorkflow
+        prng.seed_all(5)
+        w = StandardWorkflow(
+            loader_factory=lambda wf: SyntheticClassificationLoader(
+                wf, name="loader", minibatch_size=20, n_train=80,
+                n_valid=20, shape=(6, 6, 1), n_classes=4),
+            layers=[{"type": "resizable_all2all",
+                     "->": {"output_sample_shape": 8},
+                     "<-": {"learning_rate": 0.05,
+                            "gradient_moment": 0.9}},
+                    {"type": "softmax",
+                     "->": {"output_sample_shape": 4},
+                     "<-": {"learning_rate": 0.05}}],
+            loss_function="softmax",
+            decision_config={"max_epochs": 2},
+            name="ResizeWf")
+        w.initialize(device=dev)
+        w.run()
+        hist1 = len(w.decision.history)
+        # widen the hidden layer; the softmax's input width changes, so
+        # its weights must be refilled too (fresh fine-tune phase)
+        w.forwards[0].resize(12)
+        sm = w.forwards[1]
+        sm.weights.reset()
+        sm.bias.reset()
+        sm.fill_params((0, 12))
+        sm.weights.initialize(dev)
+        sm.bias.initialize(dev)
+        w.decision.complete.set(False)
+        w.decision.max_epochs = 4
+        w.run()
+        assert w.forwards[0].weights.shape == (36, 12)
+        assert len(w.decision.history) > hist1
+        for h in w.decision.history:
+            assert np.isfinite(h["loss"])
+
+
+def _rbm_params(n_vis, n_hid):
+    return {
+        "weights": (RNG.standard_normal((n_vis, n_hid)) * 0.1)
+        .astype(np.float32),
+        "bias": np.zeros(n_hid, np.float32),
+        "vbias": np.zeros(n_vis, np.float32),
+    }
+
+
+class TestRBM:
+    def test_forward_numpy_vs_jax(self):
+        u = rbm_mod.RBM(n_hidden=5)
+        params = _rbm_params(12, 5)
+        x = RNG.random((4, 12)).astype(np.float32)
+        out_np = u.apply(params, {"input": x})
+        jp = {k: jnp.asarray(v) for k, v in params.items()}
+        out_jx = u.apply(jp, {"input": jnp.asarray(x)})
+        for k in ("output", "hidden"):
+            np.testing.assert_allclose(np.asarray(out_jx[k]),
+                                       out_np[k], rtol=1e-5, atol=1e-5)
+        assert out_np["output"].shape == x.shape
+        assert out_np["hidden"].shape == (4, 5)
+
+    def test_cd1_matches_independent_oracle(self):
+        """GDRBM's numpy path vs a from-scratch CD-1 transcription
+        (identical Bernoulli draws via the same 'rbm' stream seed)."""
+        u = rbm_mod.RBM(n_hidden=6)
+        gd = rbm_mod.GDRBM(forward=u)
+        params = _rbm_params(10, 6)
+        x = RNG.random((8, 10)).astype(np.float32)
+        h0_prob = u.hidden_of(params, x)
+
+        prng.seed_all(77)
+        _, grads = gd.backward_from_saved(params, (x, h0_prob, None),
+                                          np.zeros_like(x))
+
+        prng.seed_all(77)
+        gen = prng.get("rbm").numpy
+        sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+        h0 = (gen.random(h0_prob.shape) < h0_prob).astype(np.float32)
+        v1 = sig(h0 @ params["weights"].T + params["vbias"])
+        h1 = sig(v1 @ params["weights"] + params["bias"])
+        n = x.shape[0]
+        np.testing.assert_allclose(
+            grads["weights"], -(x.T @ h0_prob - v1.T @ h1) / n,
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            grads["bias"], -(h0_prob - h1).sum(0) / n,
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            grads["vbias"], -(x - v1).sum(0) / n, rtol=1e-5, atol=1e-6)
+
+    def test_binarization(self):
+        u = rbm_mod.Binarization()
+        x = RNG.random((200, 7)).astype(np.float32)
+        prng.seed_all(3)
+        y, _ = u.apply_fwd({}, x, train=True)
+        assert set(np.unique(y)) <= {0.0, 1.0}
+        # statistics follow the probabilities
+        assert abs(y.mean() - x.mean()) < 0.05
+        # eval mode: deterministic threshold
+        y_eval, _ = u.apply_fwd({}, x, train=False)
+        np.testing.assert_array_equal(y_eval, (x > 0.5).astype(np.float32))
+
+    def test_workflow_reconstruction_improves_fused(self, dev):
+        from veles_tpu.models import mnist_rbm
+        fl = FakeLauncher()
+        w = mnist_rbm.create_workflow(
+            fl, loader={"minibatch_size": 25, "n_train": 300,
+                        "n_valid": 50, "targets_from_data": True},
+            decision={"max_epochs": 5})
+        w.initialize(device=dev)
+        w.run()
+        val = [h["loss"] for h in w.decision.history
+               if h["class"] == "validation"]
+        assert val[-1] < val[0], val
+
+    def test_workflow_numpy_eager(self):
+        from veles_tpu.models import mnist_rbm
+        fl = FakeLauncher()
+        w = mnist_rbm.create_workflow(
+            fl, loader={"minibatch_size": 25, "n_train": 100,
+                        "n_valid": 25, "targets_from_data": True},
+            decision={"max_epochs": 2})
+        w.initialize(device=NumpyDevice())
+        w.run()
+        assert len(w.decision.history) == 4
+        for h in w.decision.history:
+            assert np.isfinite(h["loss"])
+
+    def test_fused_determinism(self, dev):
+        """Two identically-seeded fused runs produce identical metric
+        histories (CD sampling keys are (seed, step)-deterministic)."""
+        from veles_tpu.models import mnist_rbm
+        hists = []
+        for _ in range(2):
+            prng.seed_all(42)
+            fl = FakeLauncher()
+            w = mnist_rbm.create_workflow(
+                fl, loader={"minibatch_size": 20, "n_train": 100,
+                            "n_valid": 20, "targets_from_data": True},
+                decision={"max_epochs": 2})
+            w.initialize(device=dev)
+            w.run()
+            hists.append([(h["class"], h["loss"])
+                          for h in w.decision.history])
+        assert hists[0] == hists[1]

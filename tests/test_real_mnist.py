@@ -43,6 +43,22 @@ class TestIdxRoundtrip:
         for f, t in mtimes.items():
             assert os.path.getmtime(os.path.join(base, f)) == t
 
+    def test_partial_genuine_set_never_overwritten(self, tmp_path):
+        """A partial pre-placed IDX set must raise, not be silently
+        replaced with synthetic data (code-review finding)."""
+        import os
+        genuine = (np.zeros((4, 28, 28), np.uint8) + 7)
+        datasets.write_idx(
+            str(tmp_path / "train-images-idx3-ubyte"), genuine)
+        with pytest.raises(FileExistsError, match="partial"):
+            datasets.generate_mnist_idx(str(tmp_path), n_train=16,
+                                        n_test=8)
+        # the genuine file survived untouched
+        back = datasets._read_idx(
+            str(tmp_path / "train-images-idx3-ubyte"))
+        np.testing.assert_array_equal(back, genuine)
+        assert not os.path.exists(tmp_path / "t10k-labels-idx1-ubyte")
+
 
 class TestRealFileLoading:
     def test_loader_prefers_real_files(self, idx_dir):

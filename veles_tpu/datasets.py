@@ -72,9 +72,20 @@ def generate_mnist_idx(target_dir: Optional[str] = None,
     IDX files are ever pre-placed there, they are left untouched."""
     base = target_dir or os.path.join(data_dir(), "mnist")
     os.makedirs(base, exist_ok=True)
-    if all(os.path.exists(os.path.join(base, f))
-           for f in _MNIST_FILES.values()):
+    present = [f for f in _MNIST_FILES.values()
+               if os.path.exists(os.path.join(base, f))
+               or os.path.exists(os.path.join(base, f + ".gz"))]
+    if len(present) == len(_MNIST_FILES):
         return base
+    if present:
+        # NEVER overwrite a partial genuine set with synthetic data —
+        # the user must complete or remove it
+        missing = sorted(set(_MNIST_FILES.values()) - set(present))
+        raise FileExistsError(
+            f"{base} holds a partial MNIST IDX set ({present}); "
+            f"refusing to overwrite with the synthetic stand-in. "
+            f"Add the missing files {missing} or remove the partial "
+            f"set.")
     (tx, ty), (vx, vy), _ = synthetic_classification(
         n_train, n_test, (28, 28, 1), n_classes=10, seed=seed)
     write_idx(os.path.join(base, _MNIST_FILES["train_images"]),
@@ -102,6 +113,186 @@ def try_load_real_mnist() -> Optional[Tuple[Split, Split]]:
     vx = _read_idx(paths["test_images"]).astype(np.float32) / 255.0
     vy = _read_idx(paths["test_labels"]).astype(np.int32)
     return (tx[..., None], ty), (vx[..., None], vy)
+
+
+# -- ImageNet offline preparation --------------------------------------
+
+def prepare_imagenet(source: str, out_dir: str,
+                     image_size: int = 227, valid_frac: float = 0.1,
+                     quality: int = 92,
+                     progress_every: int = 5000) -> dict:
+    """Offline ImageNet preparation (reference parity: the AlexNet
+    sample's preparation scripts — resizing, label json, mean image;
+    SURVEY.md §3.2 samples row).
+
+    ``source`` is an archive (.tar/.tar.gz/.tgz/.zip) or a directory,
+    holding either ``<split>/<class>/img`` (splits preserved) or flat
+    ``<class>/img`` (split deterministically by ``valid_frac``).  Each
+    image is decoded, bilinear-resized to ``image_size`` square RGB and
+    re-encoded as JPEG under ``out_dir/<split>/<class>/`` — so training
+    -time decode work is minimal and every row is already the static
+    shape XLA needs.  Also writes:
+
+    - ``labels.json``: sorted class name -> integer id;
+    - ``mean_image.npy``: float32 (size, size, 3) mean over the TRAIN
+      split in [0, 1] (the reference subtracts the mean image);
+    - ``manifest.json``: per-split counts + parameters.
+
+    Returns the manifest dict.  The output tree is exactly what
+    ``ImageDirectoryLoader(data_dir=out_dir)`` expects, and
+    ``models/alexnet.py`` accepts it via ``loader.data_dir``.
+    """
+    import json as _json
+    import shutil
+    import tarfile
+    import zipfile
+
+    from PIL import Image
+
+    src = os.path.expanduser(source)
+    out = os.path.expanduser(out_dir)
+    extracted = None
+    if os.path.isfile(src):
+        extracted = os.path.join(out, "_extracted")
+        os.makedirs(extracted, exist_ok=True)
+        if src.endswith(".zip"):
+            with zipfile.ZipFile(src) as z:
+                z.extractall(extracted)
+        else:
+            with tarfile.open(src) as t:
+                try:
+                    t.extractall(extracted, filter="data")
+                except TypeError:  # pre-3.10.12/3.11.4: no filter=
+                    t.extractall(extracted)
+        src = extracted
+    if not os.path.isdir(src):
+        raise ValueError(f"prepare_imagenet: {source!r} is neither a "
+                         f"directory nor a readable archive")
+
+    img_ext = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".ppm",
+               ".tif", ".tiff", ".webp")
+
+    def is_img(fn: str) -> bool:
+        return fn.lower().endswith(img_ext)
+
+    def classes_of(d: str):
+        return sorted(e for e in os.listdir(d)
+                      if os.path.isdir(os.path.join(d, e)))
+
+    # descend through pure wrapper directories (`tar czf x.tgz ILSVRC/`
+    # puts everything under one top-level dir that is neither a split
+    # nor a class) — a wrapper has exactly one subdir and no images
+    while True:
+        entries = os.listdir(src)
+        subdirs = [e for e in entries
+                   if os.path.isdir(os.path.join(src, e))]
+        if len(subdirs) == 1 and not any(is_img(e) for e in entries) \
+                and not any(is_img(f) for f in
+                            os.listdir(os.path.join(src, subdirs[0]))):
+            nxt = os.path.join(src, subdirs[0])
+            # don't descend past a split layout ("train" at this level)
+            if subdirs[0].lower() in ("train", "validation", "valid",
+                                      "test"):
+                break
+            src = nxt
+        else:
+            break
+
+    # detect layout: split dirs present, or flat class dirs
+    split_names = {"train": "train", "validation": "validation",
+                   "valid": "validation", "test": "test"}
+    splits: dict = {}
+    present = [e for e in os.listdir(src) if e.lower() in split_names
+               and os.path.isdir(os.path.join(src, e))]
+    if present:
+        for e in present:
+            splits[split_names[e.lower()]] = os.path.join(src, e)
+        class_names = sorted(set().union(
+            *(classes_of(d) for d in splits.values())))
+    else:
+        splits["__flat__"] = src
+        class_names = classes_of(src)
+    if not class_names:
+        raise ValueError(f"prepare_imagenet: no class directories "
+                         f"found under {src!r}")
+    label_of = {n: i for i, n in enumerate(class_names)}
+
+    os.makedirs(out, exist_ok=True)
+    mean_acc = np.zeros((image_size, image_size, 3), np.float64)
+    counts = {"train": 0, "validation": 0, "test": 0}
+
+    # plan all (src_path, split, dst_path) first: collision-safe names
+    # (img001.png + img001.jpeg must not overwrite each other) and a
+    # deterministic validation split for flat layouts
+    jobs = []
+    taken: set = set()
+    for split_key, sdir in splits.items():
+        for cls in classes_of(sdir):
+            cdir = os.path.join(sdir, cls)
+            files = sorted(f for f in os.listdir(cdir) if is_img(f))
+            for j, fn in enumerate(files):
+                if split_key == "__flat__":
+                    # every round(1/frac)-th file goes to validation
+                    period = max(2, int(round(1.0 / valid_frac))) \
+                        if valid_frac > 0 else 0
+                    split = "validation" if period and \
+                        j % period == period - 1 else "train"
+                else:
+                    split = split_key
+                dst_dir = os.path.join(out, split, cls)
+                base = os.path.splitext(fn)[0]
+                dst = os.path.join(dst_dir, base + ".jpg")
+                k = 1
+                while dst in taken:
+                    k += 1
+                    dst = os.path.join(dst_dir, f"{base}.{k}.jpg")
+                taken.add(dst)
+                os.makedirs(dst_dir, exist_ok=True)
+                jobs.append((os.path.join(cdir, fn), split, dst))
+
+    def convert(job):
+        path, split, dst = job
+        with Image.open(path) as im:
+            im = im.convert("RGB")
+            if im.size != (image_size, image_size):
+                im = im.resize((image_size, image_size), Image.BILINEAR)
+            im.save(dst, "JPEG", quality=quality)
+            # mean contribution returned, accumulated serially (the
+            # pool must not race on mean_acc)
+            arr = np.asarray(im, np.float64) / 255.0 \
+                if split == "train" else None
+        return split, arr
+
+    from concurrent.futures import ThreadPoolExecutor
+    workers = min(os.cpu_count() or 4, 16)
+    with ThreadPoolExecutor(workers) as pool:
+        for done, (split, arr) in enumerate(pool.map(convert, jobs), 1):
+            counts[split] += 1
+            if arr is not None:
+                mean_acc += arr
+            if progress_every and done % progress_every == 0:
+                print(f"prepare-imagenet: {done}/{len(jobs)} images "
+                      f"converted")
+
+    if not any(counts.values()):
+        raise ValueError(
+            f"prepare_imagenet: found class directories "
+            f"{class_names[:5]}... under {src!r} but zero images — "
+            f"wrong layout? expected <split>/<class>/img or "
+            f"<class>/img")
+    if counts["train"]:
+        mean = (mean_acc / counts["train"]).astype(np.float32)
+        np.save(os.path.join(out, "mean_image.npy"), mean)
+    with open(os.path.join(out, "labels.json"), "w") as f:
+        _json.dump(label_of, f, indent=1, sort_keys=True)
+    manifest = {"image_size": image_size, "n_classes": len(class_names),
+                "counts": counts, "source": source,
+                "mean_image": bool(counts["train"])}
+    with open(os.path.join(out, "manifest.json"), "w") as f:
+        _json.dump(manifest, f, indent=1)
+    if extracted is not None:
+        shutil.rmtree(extracted, ignore_errors=True)
+    return manifest
 
 
 # -- synthetic generators ----------------------------------------------
@@ -173,7 +364,24 @@ def _main(argv=None) -> int:
     mk.add_argument("dir", nargs="?", default=None)
     mk.add_argument("--n-train", type=int, default=60000)
     mk.add_argument("--n-test", type=int, default=10000)
+    prep = sub.add_parser(
+        "prepare-imagenet",
+        help="resize + re-encode an image archive/tree into the "
+             "<out>/<split>/<class>/img layout with labels.json and "
+             "the train-split mean image")
+    prep.add_argument("source", help="archive (.tar[.gz]/.zip) or "
+                                     "directory of class subdirs")
+    prep.add_argument("--out", required=True)
+    prep.add_argument("--image-size", type=int, default=227)
+    prep.add_argument("--valid-frac", type=float, default=0.1)
+    prep.add_argument("--quality", type=int, default=92)
     args = p.parse_args(argv)
+    if args.cmd == "prepare-imagenet":
+        manifest = prepare_imagenet(
+            args.source, args.out, image_size=args.image_size,
+            valid_frac=args.valid_frac, quality=args.quality)
+        print(manifest)
+        return 0
     base = generate_mnist_idx(args.dir, args.n_train, args.n_test)
     print(base)
     return 0

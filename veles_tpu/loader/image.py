@@ -173,7 +173,12 @@ class FileListImageLoader(FullBatchLoader):
                 raise ValueError(
                     f"{self.name}: normalization needs a TRAIN split")
             off = self.class_offset(TRAIN)
-            sample = np.arange(off, off + min(n_train, self.norm_sample))
+            # evenly spaced across the WHOLE train range, not a prefix:
+            # directory listings are sorted by class, so a prefix
+            # sample would see one class only and bias the statistics
+            n_fit = min(n_train, self.norm_sample)
+            sample = off + np.unique(
+                np.linspace(0, n_train - 1, n_fit).astype(np.int64))
             self.normalizer = make_normalizer(
                 self.normalization_type,
                 **self.normalization_parameters)

@@ -91,13 +91,46 @@ DEFAULTS = {
 }
 
 
+_SYNTH_ONLY_KEYS = ("n_train", "n_valid", "shape", "n_classes",
+                    "noise", "max_shift", "seed")
+
+
+def _make_loader(wf, cfg):
+    """Real prepared ImageNet tree when ``loader.data_dir`` points at
+    `python -m veles_tpu.datasets prepare-imagenet` output; synthetic
+    stand-in otherwise (this image ships no datasets).  Every other
+    loader key (normalization_type, streaming, norm_sample, ...) passes
+    through to ImageDirectoryLoader."""
+    lcfg = dict(cfg["loader"])
+    data_dir = lcfg.pop("data_dir", None)
+    if data_dir:
+        from veles_tpu.loader.image import ImageDirectoryLoader
+        size = int(lcfg.pop("image_size", 227))
+        for k in _SYNTH_ONLY_KEYS:
+            lcfg.pop(k, None)
+        return ImageDirectoryLoader(
+            wf, name="loader", data_dir=data_dir,
+            target_shape=(size, size, 3), **lcfg)
+    return SyntheticClassificationLoader(wf, name="loader", **lcfg)
+
+
 def create_workflow(launcher, **overrides):
     cfg = model_config("alexnet", DEFAULTS).todict()
     cfg.update(overrides)
+    data_dir = (cfg.get("loader") or {}).get("data_dir")
+    if data_dir and "n_classes" not in overrides:
+        # a prepared tree knows its own class count (manifest.json)
+        import json as _json
+        import os as _os
+        mpath = _os.path.join(_os.path.expanduser(data_dir),
+                              "manifest.json")
+        if _os.path.exists(mpath):
+            with open(mpath) as f:
+                cfg["n_classes"] = int(_json.load(f)["n_classes"])
     w = StandardWorkflow(
-        loader_factory=lambda wf: SyntheticClassificationLoader(
-            wf, name="loader", **cfg["loader"]),
-        layers=alexnet_layers(cfg["n_classes"], cfg["dropout"]),
+        loader_factory=lambda wf: _make_loader(wf, cfg),
+        layers=cfg.get("layers") or
+        alexnet_layers(cfg["n_classes"], cfg["dropout"]),
         loss_function="softmax",
         decision_config=cfg["decision"],
         snapshotter_config=cfg.get("snapshotter"),

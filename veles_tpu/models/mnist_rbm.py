@@ -1,0 +1,47 @@
+"""MNIST RBM pretraining workflow.
+
+Reference parity: veles/znicz/samples MnistRBM (SURVEY.md §3.2 "RBM /
+other" row — reconstructed from the survey description, UNVERIFIED
+against the empty reference mount; SURVEY.md §0): binarized 28x28
+digits feed a 196-hidden-unit Bernoulli RBM trained by CD-1; progress
+is tracked as reconstruction MSE on the validation split.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.loader.synthetic import MnistLoader
+from veles_tpu.models import model_config
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+DEFAULTS = {
+    "loader": {"minibatch_size": 100, "n_train": 60000,
+               "n_valid": 10000, "targets_from_data": True},
+    "layers": [
+        {"type": "binarization", "->": {}, "<-": {}},
+        {"type": "rbm", "->": {"n_hidden": 196},
+         "<-": {"learning_rate": 0.1, "gradient_moment": 0.5}},
+    ],
+    "decision": {"max_epochs": 10, "fail_iterations": 50},
+    "snapshotter": None,
+}
+
+
+def create_workflow(launcher, **overrides):
+    cfg = model_config("mnist_rbm", DEFAULTS).todict()
+    cfg.update(overrides)
+    w = StandardWorkflow(
+        loader_factory=lambda wf: MnistLoader(
+            wf, name="loader", **cfg["loader"]),
+        layers=cfg["layers"],
+        loss_function="mse",
+        decision_config=cfg["decision"],
+        snapshotter_config=cfg.get("snapshotter"),
+        name="MnistRbmWorkflow")
+    launcher.workflow = w
+    return w
+
+
+def run(launcher):
+    launcher.create_workflow(create_workflow)
+    launcher.initialize()
+    launcher.run()

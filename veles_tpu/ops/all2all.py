@@ -88,6 +88,20 @@ class All2AllRELU(All2All):
         return jnp.maximum(v, 0)
 
 
+class All2AllSigmoid(All2All):
+    """Sigmoid dense layer (the RBM family's deterministic sibling —
+    a trained RBM's weights/hidden-bias drop straight into one of
+    these for fine-tuning a stacked net)."""
+
+    activation_mode = "sigmoid"
+
+    def activation(self, v):
+        if isinstance(v, np.ndarray):
+            return 1.0 / (1.0 + np.exp(-v))
+        import jax
+        return jax.nn.sigmoid(v)
+
+
 class All2AllSoftmax(All2All):
     """Softmax output layer.  ``activation_mode == 'softmax'`` tells the
     evaluator/GD contract that err_output already IS d loss/d logits
@@ -124,3 +138,4 @@ class GradientDescent(GradientUnit):
 GDTanh = GradientDescent
 GDRELU = GradientDescent
 GDSoftmax = GradientDescent
+GDSigmoid = GradientDescent

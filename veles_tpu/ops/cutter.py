@@ -1,0 +1,66 @@
+"""Cutter: crop a spatial window out of NHWC input.
+
+Reference parity: veles/znicz/cutter.py (SURVEY.md §3.2 "RBM / other"
+row — reconstructed from the survey description, UNVERIFIED against
+the reference mount, which is empty; SURVEY.md §0).  Upstream cuts a
+fixed region from each sample (e.g. the center crop feeding an
+autoencoder); backward scatters the error back into a zero canvas.
+
+TPU-first: forward is a static slice, backward a static pad — both
+shapes are compile-time constants, so XLA fuses them into neighbours
+for free (no dynamic-shape hazard inside the scanned step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from veles_tpu.ops.nn_units import ForwardUnit, GradientUnit
+
+
+class Cutter(ForwardUnit):
+    """output = input[:, top:top+height, left:left+width, :]."""
+
+    has_params = False
+
+    def __init__(self, workflow=None, padding=None, **kwargs: Any) -> None:
+        """``padding=(left, top, right, bottom)`` — the amounts cut off
+        each edge (the reference's convention)."""
+        super().__init__(workflow, **kwargs)
+        if padding is None or len(padding) != 4:
+            raise ValueError(f"{self.name}: padding=(left, top, right, "
+                             f"bottom) required")
+        self.padding = tuple(int(p) for p in padding)
+
+    def output_shape_for(self, input_shape):
+        n, h, w = input_shape[0], input_shape[1], input_shape[2]
+        left, top, right, bottom = self.padding
+        oh, ow = h - top - bottom, w - left - right
+        if oh <= 0 or ow <= 0:
+            raise ValueError(f"{self.name}: padding {self.padding} "
+                             f"consumes the whole {h}x{w} input")
+        return (n, oh, ow) + tuple(input_shape[3:])
+
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        x = inputs["input"]
+        left, top, right, bottom = self.padding
+        return {"output": x[:, top:x.shape[1] - bottom,
+                            left:x.shape[2] - right]}
+
+
+class GDCutter(GradientUnit):
+    """err_input = err_output zero-padded back to the input canvas."""
+
+    def backward_from_saved(self, params, saved, err_output):
+        x, _out = saved
+        left, top, right, bottom = self.forward.padding
+        pad = [(0, 0), (top, bottom), (left, right)] + \
+            [(0, 0)] * (err_output.ndim - 3)
+        if isinstance(err_output, np.ndarray):
+            err_in = np.pad(err_output, pad)
+        else:
+            import jax.numpy as jnp
+            err_in = jnp.pad(err_output, pad)
+        return err_in, {}

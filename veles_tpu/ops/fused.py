@@ -97,6 +97,7 @@ class FusedStepRunner(AcceleratedUnit):
         for gd in self.gds:
             if gd is None:
                 continue
+            gd.reconcile_velocities()   # param shapes may have changed
             opt[gd.name] = {k: v.unmap()
                             for k, v in gd.accumulated_grads.items()}
         return opt
@@ -106,10 +107,9 @@ class FusedStepRunner(AcceleratedUnit):
         of the framework observes updated weights."""
         for f in self.forwards:
             p = params[f.name]
-            if "weights" in p:
-                f.weights.devmem = p["weights"]
-            if "bias" in p:
-                f.bias.devmem = p["bias"]
+            for pname, vec in f.param_vectors().items():
+                if pname in p:
+                    vec.devmem = p[pname]
         for gd in self.gds:
             if gd is None:
                 continue
@@ -384,6 +384,8 @@ class FusedStepRunner(AcceleratedUnit):
     def run(self) -> None:
         ld = self.loader
         self._ensure_params()
+        if self._train_step is None:   # invalidated (e.g. a resize)
+            self._build_steps()
         if self._acc is None:
             self._acc, self._conf = self._fresh_acc()
         indices, mask = self._superstep_arrays()
@@ -475,6 +477,17 @@ class FusedStepRunner(AcceleratedUnit):
                 f"disagree")
         return lr
 
+    def invalidate_trace(self) -> None:
+        """Drop the traced steps and cached pytrees — required after
+        anything changes a parameter SHAPE (ResizableAll2All.resize).
+        Current param values are synced back to the unit Vectors first
+        so nothing is lost; the next firing re-collects and re-jits."""
+        self.sync_params_to_vectors()
+        self._params = None
+        self._opt = None
+        self._train_step = None
+        self._eval_step = None
+
     # -- metric intake (Decision / zmq slave) --------------------------
 
     def take_class_metrics(self) -> Tuple[float, float, float,
@@ -519,7 +532,7 @@ class FusedStepRunner(AcceleratedUnit):
             return
         self._scatter_params(self._params, self._opt or {})
         for f in self.forwards:
-            for v in (f.weights, f.bias):
+            for v in f.param_vectors().values():
                 if v:
                     v.map_read()
 

@@ -116,7 +116,9 @@ class ForwardUnit(AcceleratedUnit):
         out_shape = self.output_shape_for(in_shape)
         if not self.output or tuple(self.output.shape) != out_shape:
             self.output.mem = np.zeros(out_shape, np.float32)
-        for v in (self.input, self.weights, self.bias, self.output):
+        vecs = [self.input, self.output]
+        vecs.extend(self.param_vectors().values())
+        for v in vecs:
             if v:
                 v.initialize(device)
 
@@ -125,13 +127,20 @@ class ForwardUnit(AcceleratedUnit):
 
     # -- pure compute --------------------------------------------------
 
-    def gather_params(self) -> Dict[str, Any]:
+    def param_vectors(self) -> Dict[str, Vector]:
+        """name -> Vector for every populated parameter.  Subclasses
+        with extra parameters (RBM's visible bias) extend the base
+        dict — the fused runner and momentum allocation iterate THIS,
+        never a hard-coded weights/bias pair."""
         p = {}
         if self.weights:
-            p["weights"] = self.weights.unmap()
+            p["weights"] = self.weights
         if self.bias and self.include_bias:
-            p["bias"] = self.bias.unmap()
+            p["bias"] = self.bias
         return p
+
+    def gather_params(self) -> Dict[str, Any]:
+        return {k: v.unmap() for k, v in self.param_vectors().items()}
 
     def apply(self, params: Dict[str, Any], inputs: Dict[str, Any],
               rng: Any = None) -> Dict[str, Any]:
@@ -217,12 +226,32 @@ class GradientUnit(AcceleratedUnit):
             self.err_input.mem = np.zeros(f.input.shape, np.float32)
             self.err_input.initialize(device)
         if self.gradient_moment and f is not None:
-            for pname, vec in (("weights", f.weights), ("bias", f.bias)):
+            for pname, vec in f.param_vectors().items():
                 if vec and pname not in self.accumulated_grads:
                     acc = Vector(np.zeros(vec.shape, np.float32),
                                  name=f"{self.name}.vel_{pname}")
                     acc.initialize(device)
                     self.accumulated_grads[pname] = acc
+
+    def reconcile_velocities(self) -> None:
+        """Re-shape momentum buffers whose parameter changed shape
+        (ResizableAll2All.resize): the overlapping region keeps its
+        history, new entries start at zero.  No-op when shapes agree."""
+        f = self.forward
+        if f is None:
+            return
+        pvecs = f.param_vectors()
+        for pname, vec in self.accumulated_grads.items():
+            pvec = pvecs.get(pname)
+            if pvec is None or tuple(vec.shape) == tuple(pvec.shape):
+                continue
+            old = np.asarray(vec.map_read())
+            new = np.zeros(pvec.shape, np.float32)
+            overlap = tuple(slice(0, min(a, b))
+                            for a, b in zip(old.shape, new.shape))
+            new[overlap] = old[overlap]
+            vec.mem = new
+            vec.initialize(self.device)
 
     # -- backward ------------------------------------------------------
 

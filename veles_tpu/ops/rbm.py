@@ -1,0 +1,231 @@
+"""RBM pretraining family: Binarization, the RBM unit, and its CD-1
+trainer.
+
+Reference parity: veles/znicz/rbm_units.py (SURVEY.md §3.2 "RBM /
+other" row — reconstructed from the survey description, UNVERIFIED
+against the reference mount, which is empty; SURVEY.md §0).  Upstream
+decomposes contrastive divergence into many small units (Binarization,
+BatchWeights, GradientsCalculator, WeightsUpdater) because each maps to
+one OpenCL kernel.  That decomposition is kernel-shaped, not
+math-shaped, so the TPU rebuild folds the whole CD-1 step into the
+standard ForwardUnit/GradientUnit contract instead:
+
+- ``RBM`` (forward): given visible v0, computes h0 = sigmoid(v0 W + b_h)
+  and the mean-field reconstruction v1 = sigmoid(h0 W^T + b_v).  Its
+  ``output`` is the RECONSTRUCTION, so the stock ``EvaluatorMSE``
+  (with ``targets_from_data`` loaders) reports reconstruction error and
+  Decision/Snapshotter/plotters work unchanged.  The hidden
+  representation for stacking DBN layers is exposed as ``hidden_of()``
+  / the ``hidden`` Vector (eager mode).
+- ``GDRBM`` (gradient): IGNORES err_output — contrastive divergence is
+  not backprop.  From the forward residuals it samples h0 ~
+  Bernoulli(h0_prob), reconstructs v1 = sigmoid(h0 W^T + b_v), computes
+  h1 = sigmoid(v1 W + b_h), and returns the CD-1 gradients
+  ``-(positive - negative)/n`` so the shared SGD/momentum
+  ``update_params`` (nn_units.py) ASCENDS the likelihood proxy.  One
+  array-API implementation serves the numpy oracle and the fused jitted
+  scan; the stochastic keys thread through the standard
+  ``stochastic=True`` residual contract, so two seeded runs are
+  bit-identical.
+- ``Binarization``: stochastic Bernoulli sampling of [0,1] activations
+  (upstream feeds binarized pixels to the RBM); deterministic >0.5
+  threshold in eval mode; gradient passes through unchanged (straight
+  -through estimator — upstream never backprops through it at all).
+
+Known, documented divergence: CD statistics average over the full
+static minibatch, including the padded remainder rows of the last
+minibatch of an epoch (the evaluator masks them out of the METRICS;
+the reference trained with fixed-size minibatches where the issue
+cannot arise).  The pollution is bounded by pad_rows/minibatch for one
+minibatch per epoch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from veles_tpu.memory import Vector
+from veles_tpu.ops.nn_units import ForwardUnit, GradientUnit
+
+
+def _sigmoid(v):
+    if isinstance(v, np.ndarray):
+        return 1.0 / (1.0 + np.exp(-v))
+    import jax
+    return jax.nn.sigmoid(v)
+
+
+def _flat(x):
+    return x.reshape(x.shape[0], -1)
+
+
+class Binarization(ForwardUnit):
+    """output ~ Bernoulli(input) in training, input > 0.5 in eval.
+    Input values must lie in [0, 1] (pixel intensities)."""
+
+    has_params = False
+    stochastic = True
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        x = inputs["input"]
+        if isinstance(x, np.ndarray):
+            return {"output": (x > 0.5).astype(np.float32)}
+        return {"output": (x > 0.5).astype(x.dtype)}
+
+    def apply_fwd(self, params, x, rng=None, train=True):
+        if not train:
+            y = self.apply(params, {"input": x})["output"]
+            return y, (x, None)
+        if isinstance(x, np.ndarray):
+            from veles_tpu import prng as prng_mod
+            gen = prng_mod.get("binarization").numpy
+            y = (gen.random(x.shape) < x).astype(np.float32)
+        else:
+            import jax
+            if rng is None:
+                raise ValueError(f"{self.name}: traced train mode "
+                                 "needs an rng key")
+            y = jax.random.bernoulli(rng, x).astype(x.dtype)
+        return y, (x, None)
+
+    def eager_rng(self):
+        if self.device is not None and self.device.is_jax:
+            from veles_tpu import prng as prng_mod
+            return prng_mod.get("binarization").next_key()
+        return None
+
+
+class GDBinarization(GradientUnit):
+    """Straight-through: err passes unchanged (upstream has no backward
+    for Binarization — it only feeds RBM pretraining)."""
+
+    def backward_from_saved(self, params, saved, err_output):
+        return err_output, {}
+
+
+class RBM(ForwardUnit):
+    """Bernoulli-Bernoulli RBM layer.
+
+    params: ``weights`` (n_visible, n_hidden), ``bias`` (n_hidden,)
+    — the hidden bias, reusing the base attribute so weight-image
+    plotters and Forge export see it — and ``vbias`` (n_visible,).
+    ``output`` is the mean-field reconstruction (same shape as input);
+    ``hidden`` holds h0 probabilities after an eager firing.
+    """
+
+    activation_mode = "linear"  # err routing is GDRBM's business
+    stochastic = True           # CD sampling keys ride the residual
+
+    def __init__(self, workflow=None, n_hidden: int = None,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        if not n_hidden:
+            raise ValueError(f"{self.name}: n_hidden required")
+        self.n_hidden = int(n_hidden)
+        self.vbias = Vector(name=f"{self.name}.vbias")
+        self.hidden = Vector(name=f"{self.name}.hidden")
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)   # reconstruction
+
+    def param_shapes(self, input_shape):
+        n_vis = int(np.prod(input_shape[1:]))
+        return {"weights": (n_vis, self.n_hidden),
+                "bias": (self.n_hidden,),
+                "vbias": (n_vis,)}
+
+    def param_vectors(self) -> Dict[str, Vector]:
+        p = super().param_vectors()
+        if self.vbias:
+            p["vbias"] = self.vbias
+        return p
+
+    # -- pure compute --------------------------------------------------
+
+    def hidden_of(self, params, x):
+        """h probabilities — the representation stacked DBN layers
+        consume."""
+        return _sigmoid(_flat(x) @ params["weights"] + params["bias"])
+
+    def reconstruct(self, params, h):
+        return _sigmoid(h @ params["weights"].T + params["vbias"])
+
+    def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
+        x = inputs["input"]
+        h0 = self.hidden_of(params, x)
+        v1 = self.reconstruct(params, h0).reshape(x.shape)
+        return {"output": v1, "hidden": h0}
+
+    def apply_fwd(self, params, x, rng=None, train=True):
+        out = self.apply(params, {"input": x}, rng)
+        # residual carries the rng key: GDRBM's CD sampling must be
+        # deterministic per (seed, step) like dropout's mask
+        return out["output"], (x, out["hidden"], rng)
+
+    def jax_run(self) -> None:
+        params = self.gather_params()
+        x = self.input.unmap()
+        out = self.apply(params, {"input": x}, rng=None)
+        self._last_residual = (x, out["hidden"], self.eager_rng())
+        self.output.devmem = out["output"]
+        self.hidden.devmem = out["hidden"]
+
+    def numpy_run(self) -> None:
+        params = {k: np.asarray(v) for k, v in self.gather_params().items()}
+        x = self.input.map_read()
+        out = self.apply(params, {"input": x})
+        self._last_residual = (x, out["hidden"], None)
+        self.output.map_invalidate()[:] = out["output"]
+        if not self.hidden:
+            self.hidden.mem = np.zeros(out["hidden"].shape, np.float32)
+            self.hidden.initialize(self.device)
+        self.hidden.map_invalidate()[:] = np.asarray(out["hidden"])
+
+    def eager_rng(self):
+        if self.device is not None and self.device.is_jax:
+            from veles_tpu import prng as prng_mod
+            return prng_mod.get("rbm").next_key()
+        return None
+
+
+class GDRBM(GradientUnit):
+    """CD-1 trainer for :class:`RBM`.  err_output is ignored (CD is not
+    backprop); err_input is zeros — an RBM is pretrained as the first
+    layer of its workflow, nothing upstream consumes its error."""
+
+    def backward_from_saved(self, params, saved, err_output):
+        x, h0_prob, rng = saved
+        v0 = _flat(x)
+        n = v0.shape[0]
+        if isinstance(v0, np.ndarray):
+            from veles_tpu import prng as prng_mod
+            gen = prng_mod.get("rbm").numpy
+            h0 = (gen.random(h0_prob.shape) < h0_prob) \
+                .astype(np.float32)
+        else:
+            import jax
+            if rng is None:
+                raise ValueError(f"{self.name}: traced CD-1 needs the "
+                                 "forward's rng key in the residual")
+            h0 = jax.random.bernoulli(rng, h0_prob).astype(v0.dtype)
+        f = self.forward
+        v1 = f.reconstruct(params, h0)
+        h1 = _sigmoid(v1 @ params["weights"] + params["bias"])
+        # update_params does w -= lr*g: negate so SGD ASCENDS the
+        # CD objective (positive phase - negative phase)
+        grads = {
+            "weights": -(v0.T @ h0_prob - v1.T @ h1) / n,
+            "bias": -(h0_prob - h1).sum(axis=0) / n,
+            "vbias": -(v0 - v1).sum(axis=0) / n,
+        }
+        if isinstance(v0, np.ndarray):
+            err_in = np.zeros(x.shape, np.float32)
+        else:
+            import jax.numpy as jnp
+            err_in = jnp.zeros(x.shape, x.dtype)
+        return err_in, grads

@@ -83,6 +83,18 @@ def _populate() -> None:
         from veles_tpu.ops import lrn
         register("norm", lrn.LRNormalizer, lrn.GDLRNormalizer)
 
+    @family("rbm/cutter/resizable")
+    def _rbm():
+        from veles_tpu.ops import cutter, rbm, resizable_all2all
+        from veles_tpu.ops import all2all
+        register("all2all_sigmoid", all2all.All2AllSigmoid,
+                 all2all.GDSigmoid)
+        register("rbm", rbm.RBM, rbm.GDRBM)
+        register("binarization", rbm.Binarization, rbm.GDBinarization)
+        register("cutter", cutter.Cutter, cutter.GDCutter)
+        register("resizable_all2all", resizable_all2all.ResizableAll2All,
+                 resizable_all2all.GDResizableAll2All)
+
     @family("deconv/depooling")
     def _deconv():
         from veles_tpu.ops import deconv, depooling

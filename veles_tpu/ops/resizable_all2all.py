@@ -1,0 +1,74 @@
+"""ResizableAll2All: a dense layer whose output width can change
+between training phases, preserving the already-learned columns.
+
+Reference parity: veles/znicz/resizable_all2all.py (SURVEY.md §3.2
+"RBM / other" row — reconstructed from the survey description,
+UNVERIFIED against the reference mount, which is empty; SURVEY.md §0).
+Upstream grows/shrinks a layer mid-experiment (e.g. widening a
+bottleneck between runs, or the genetics tuner mutating layer sizes
+without discarding a warm start).
+
+TPU-first note: a resize changes parameter SHAPES, which invalidates
+the fused runner's traced step and cached pytrees; ``resize`` calls
+``workflow.fused.invalidate_trace()`` when one is installed, so the
+next firing re-collects params and re-jits (a deliberate, explicit
+recompile — dynamic shapes inside the trace would be far worse).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from veles_tpu.ops.all2all import All2All, GradientDescent
+
+
+class ResizableAll2All(All2All):
+    def resize(self, new_output: int) -> None:
+        """Change the output width to ``new_output``.  Kept columns
+        carry their trained values; new columns are freshly filled
+        through the 'weights' PRNG stream."""
+        new_output = int(new_output)
+        old = self.neurons_number
+        if new_output == old:
+            return
+        if new_output <= 0:
+            raise ValueError(f"{self.name}: resize to {new_output}")
+        # flush the fused runner's cached param pytree into the unit
+        # Vectors BEFORE touching shapes — afterwards the stale cache
+        # would overwrite the resized weights on its way out
+        fused = getattr(self.workflow, "fused", None)
+        if fused is not None:
+            fused.invalidate_trace()
+        old_w = self.weights.map_read() if self.weights else None
+        old_b = self.bias.map_read() if self.bias else None
+        self.output_sample_shape = (new_output,)
+        if old_w is not None:
+            in_shape = (0, old_w.shape[0])  # batch dim unused
+            self.weights.reset()
+            self.bias.reset()
+            self.fill_params(in_shape)
+            n_keep = min(old, new_output)
+            w = self.weights.mem
+            w[:, :n_keep] = old_w[:, :n_keep]
+            if old_b is not None and self.bias:
+                self.bias.mem[:n_keep] = old_b[:n_keep]
+            self.weights.initialize(self.device)
+            self.bias.initialize(self.device)
+        if self.output:
+            self.output.mem = np.zeros(
+                (self.output.shape[0], new_output), np.float32)
+            self.output.initialize(self.device)
+        self.info("resized %s: %d -> %d outputs", self.name, old,
+                  new_output)
+
+
+class GDResizableAll2All(GradientDescent):
+    """Standard dense backward; momentum buffers re-shape after a
+    resize (reconcile_velocities — prefix history preserved), both on
+    re-initialize and lazily when the fused runner re-collects."""
+
+    def initialize(self, device=None, **kwargs: Any) -> None:
+        self.reconcile_velocities()
+        super().initialize(device=device, **kwargs)
