@@ -37,17 +37,19 @@ import numpy as np
 SUPERSTEP = int(os.environ.get("BENCH_SUPERSTEP", "8"))
 
 
-def build(mb, n_train, image, n_classes):
+def build(mb, n_train, image, n_classes, streaming=False):
     from veles_tpu import prng
     from veles_tpu.loader.synthetic import SyntheticClassificationLoader
     from veles_tpu.models.alexnet import alexnet_layers
     from veles_tpu.ops.standard_workflow import StandardWorkflow
 
     prng.seed_all(1234)
+    lkw = {"max_resident_bytes": 0} if streaming else {}
     w = StandardWorkflow(
         loader_factory=lambda wf: SyntheticClassificationLoader(
             wf, name="loader", minibatch_size=mb, n_train=n_train,
-            n_valid=0, shape=image, n_classes=n_classes, seed=227227),
+            n_valid=0, shape=image, n_classes=n_classes, seed=227227,
+            **lkw),
         layers=alexnet_layers(n_classes),
         loss_function="softmax",
         decision_config={"max_epochs": 10 ** 9},
@@ -93,7 +95,7 @@ def secondary_metric():
         workflow = None
 
     prng.seed_all(1234)
-    w = mnist7.create_workflow(_FL(), decision={"max_epochs": 60})
+    w = mnist7.create_workflow(_FL(), decision={"max_epochs": 40})
     w.initialize(device=make_device("auto"))
     orig_run = w.decision.run
 
@@ -112,24 +114,9 @@ def secondary_metric():
     return round(dt, 2) if reached else None
 
 
-def main() -> None:
-    from veles_tpu import profiling
-    from veles_tpu.backends import make_device
-
-    mb = int(sys.argv[1]) if len(sys.argv) > 1 else 128
-    firings = int(sys.argv[2]) if len(sys.argv) > 2 else 24
-    repeats = int(sys.argv[3]) if len(sys.argv) > 3 else 3
-    warmup = 3
-
-    # n_train sized so every loader firing yields a full superstep of
-    # k=SUPERSTEP minibatches; dataset stays well under HBM (~1.3 GB).
-    w = build(mb=mb, n_train=mb * SUPERSTEP * 2, image=(227, 227, 3),
-              n_classes=1000)
-    device = make_device("auto")
-    w.initialize(device=device)
-    if not device.is_jax:
-        raise SystemExit("bench needs a jax device (TPU or XLA:CPU)")
-
+def measure_rate(w, firings, repeats, warmup=3):
+    """Median images/sec over ``repeats`` timed windows, bracketed by
+    the data-dependent metric-carry sync."""
     loader, fused = w.loader, w.fused
 
     def fire():
@@ -139,7 +126,6 @@ def main() -> None:
     for _ in range(warmup):
         fire()
     sync_images(fused)
-
     rates = []
     for _ in range(repeats):
         images0 = sync_images(fused)
@@ -149,11 +135,109 @@ def main() -> None:
         images1 = sync_images(fused)          # the honest barrier
         dt = time.perf_counter() - t0
         rates.append((images1 - images0) / dt)
+    return float(np.median(rates)), rates
 
-    images_per_sec = float(np.median(rates))
+
+def streaming_metric(mb, n_train, device, firings, repeats):
+    """ImageNet cannot be HBM-resident: measure the host-assembled,
+    prefetch-overlapped streaming path against the resident gather path
+    (round-2 VERDICT next #3).  Any failure here must NOT lose the
+    already-measured primary metric — the caller emits null fields.
+
+    Besides the achieved rate this also measures the environment's raw
+    host->device floor — a timed ``device_put`` of one assembled
+    superstep batch — because on a tunneled/remote TPU the transfer
+    link, not the pipeline, bounds streaming: the honest claim is
+    "streaming achieves X% of what this host can physically feed"
+    (pipeline efficiency), alongside the raw ratio vs the resident
+    path.  Returns (rate, h2d_floor_rate) or None."""
+    if os.environ.get("BENCH_SKIP_STREAMING"):
+        return None
+    try:
+        import jax
+        w = build(mb=mb, n_train=n_train, image=(227, 227, 3),
+                  n_classes=1000, streaming=True)
+        w.initialize(device=device)
+        if not w.fused.streaming:
+            raise RuntimeError(
+                "residency budget did not force streaming")
+        # one firing so the loader has assembled a superstep batch
+        w.loader.run()
+        batch = w.loader.superstep_data
+        n_img = batch.shape[0] * batch.shape[1]
+        jax.device_put(batch, device.jax_device).block_until_ready()
+        puts = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.device_put(batch, device.jax_device).block_until_ready()
+            puts.append(time.perf_counter() - t0)
+        h2d_rate = n_img / float(np.median(puts))
+        w.fused.run()   # consume the assembled batch
+        rate, _ = measure_rate(w, firings, repeats, warmup=1)
+        w.stop()
+        return rate, h2d_rate
+    except Exception as e:  # noqa: BLE001 — secondary measurement
+        print(f"streaming metric failed: {e}", file=sys.stderr)
+        return None
+
+
+def main() -> None:
+    # bench builds the identical giant synthetic set twice (resident +
+    # streaming) — opt into the dataset memo (datasets._synth_cache)
+    os.environ.setdefault("VELES_TPU_SYNTH_CACHE", "1")
+    from veles_tpu import profiling
+    from veles_tpu.backends import make_device
+
+    # defaults = the measured-best configuration (docs/perf.md sweep):
+    # mb=512 amortizes optimizer/weight traffic, superstep 8 amortizes
+    # dispatch
+    mb = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    firings = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    repeats = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+    t_start = time.perf_counter()
+
+    def phase(msg):
+        print(f"[bench +{time.perf_counter() - t_start:6.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    # n_train sized so every loader firing yields a full superstep of
+    # k=SUPERSTEP minibatches; two groups of variety when that stays
+    # small, one group otherwise (synthetic generation + HBM for a
+    # 227x227x3 f32 row is ~618 KB/image — 2x at mb=512 ss=16 would be
+    # 10 GB of host datagen for zero measurement value)
+    n_train = mb * SUPERSTEP * (2 if mb * SUPERSTEP <= 2048 else 1)
+    phase(f"building resident workflow (n_train={n_train})")
+    w = build(mb=mb, n_train=n_train, image=(227, 227, 3),
+              n_classes=1000)
+    device = make_device("auto")
+    w.initialize(device=device)
+    if not device.is_jax:
+        raise SystemExit("bench needs a jax device (TPU or XLA:CPU)")
+
+    phase("measuring resident path (incl. compile)")
+    images_per_sec, rates = measure_rate(w, firings, repeats)
     flops = profiling.model_flops_per_sample(w.forwards)
     jdev = device.jax_device
     u = profiling.mfu(images_per_sec, flops["train"], jdev)
+    w.stop()
+    # Release the resident workflow's HBM (dataset + params + metric
+    # carries) before the streaming build, or the two workflows'
+    # buffers coexist and the 16 GB chip OOMs.  The unit graph is
+    # cyclic, so dropping refs is not enough — collect explicitly.
+    w.fused.release_device_state()
+    w.loader.original_data.reset()
+    w.loader.original_labels.reset()
+    w.loader.original_targets.reset()
+    del w
+    import gc
+    gc.collect()
+    phase(f"resident: {images_per_sec:.0f} img/s; measuring streaming")
+    stream = streaming_metric(mb, n_train, device,
+                              max(6, firings // 4), 2)
+    stream_rate, h2d_rate = stream if stream else (None, None)
+    phase("streaming done; secondary metric (MNIST-conv to 99%)")
+    secondary = secondary_metric()
+    phase("done")
     print(json.dumps({
         "metric": "alexnet_train_images_per_sec_per_chip",
         "value": round(images_per_sec, 2),
@@ -167,7 +251,22 @@ def main() -> None:
         "mfu": round(u, 4) if u is not None else None,
         "device_kind": getattr(jdev, "device_kind", "unknown"),
         "runs_images_per_sec": [round(r, 2) for r in rates],
-        "mnist_conv_time_to_99_sec": secondary_metric(),
+        "streaming_images_per_sec":
+            round(stream_rate, 2) if stream_rate else None,
+        "streaming_ratio":
+            round(stream_rate / images_per_sec, 4) if stream_rate
+            else None,
+        # what this host can physically push to the device (timed raw
+        # device_put of one superstep batch) and how close the full
+        # pipeline gets to that bound — on a tunneled TPU the link is
+        # the wall, and this pair shows whether the FRAMEWORK or the
+        # LINK is leaving throughput behind (docs/perf.md)
+        "streaming_h2d_floor_images_per_sec":
+            round(h2d_rate, 2) if h2d_rate else None,
+        "streaming_pipeline_efficiency":
+            round(stream_rate / min(h2d_rate, images_per_sec), 4)
+            if stream_rate and h2d_rate else None,
+        "mnist_conv_time_to_99_sec": secondary,
     }))
 
 

@@ -201,6 +201,28 @@ class TestLRN:
         u = lrn_mod.LRNormalizer(n=5)
         check_unit(u, lrn_mod.GDLRNormalizer, (2, 3, 3, 8))
 
+    def test_jax_banded_matmul_matches_numpy_oracle_both_parities(self):
+        """The jax path's banded-matmul window sum must agree with the
+        independent numpy shifted-adds oracle for ODD and EVEN window
+        sizes (an n+1-tap symmetric band would pass only odd n)."""
+        import jax.numpy as jnp
+        for n in (4, 5):
+            u = lrn_mod.LRNormalizer(alpha=3e-2, beta=0.75, n=n, k=2.0)
+            x = RNG.standard_normal((2, 3, 3, 8)).astype(np.float32)
+            err = RNG.standard_normal(x.shape).astype(np.float32)
+
+            y_np, res_np = u.apply_fwd({}, x)
+            y_jx, res_jx = u.apply_fwd({}, jnp.asarray(x))
+            np.testing.assert_allclose(np.asarray(y_jx), y_np,
+                                       rtol=2e-5, atol=1e-6)
+
+            gd = lrn_mod.GDLRNormalizer(forward=u)
+            ein_np, _ = gd.backward_from_saved({}, res_np, err)
+            ein_jx, _ = gd.backward_from_saved({}, res_jx,
+                                               jnp.asarray(err))
+            np.testing.assert_allclose(np.asarray(ein_jx), ein_np,
+                                       rtol=2e-4, atol=1e-5)
+
 
 class TestDropout:
     def test_eval_identity(self):

@@ -17,7 +17,7 @@ import sys
 
 from veles_tpu.config import parse_overrides
 from veles_tpu.launcher import (Launcher, apply_config_file,
-                                load_workflow_module)
+                                drive_workflow)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -51,6 +51,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimize", default=None, metavar="POP:GEN",
                    help="GA-tune config values wrapped in Tune(...): "
                         "population size : generations (e.g. 8:5)")
+    p.add_argument("--ga-workers", type=int, default=0,
+                   help="parallel genome-evaluation subprocesses "
+                        "(0 = auto: up to 4 with -b cpu/numpy, else 1 "
+                        "— a possibly-present TPU chip is exclusive "
+                        "and must not be probed from the GA parent)")
+    p.add_argument("--ga-eval-timeout", type=float, default=3600,
+                   help="seconds before a genome's training run is "
+                        "killed and scored inf (default 3600)")
+    p.add_argument("--ga-state", default=None, metavar="FILE",
+                   help="per-generation GA checkpoint; an existing "
+                        "file resumes the run")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run plus "
                         "a per-layer FLOPs table into DIR")
@@ -83,42 +94,64 @@ def main(argv=None) -> int:
         server.bind()
         args.plotters = True  # an endpoint without plotters is silence
 
-    launcher = Launcher(
-        backend=args.backend, seed=args.seed, snapshot=args.snapshot,
-        dp=args.dp, master_address=args.master_address,
-        listen_address=args.listen_address, multihost=args.multihost,
-        plotters=args.plotters, status_server=args.status_server,
-        profile=args.profile, verbose=args.verbose)
-
     if args.dump_config:
         from veles_tpu.config import root
         root.print_()
         return 0
 
     if args.optimize:
-        return run_optimizer(args, workflow_file)
+        # NO Launcher here: constructing one acquires the device, and
+        # an exclusive TPU grabbed by the GA parent would lock every
+        # worker subprocess out of the chip
+        return run_optimizer(args, workflow_file, config_files,
+                             overrides)
 
-    mod = load_workflow_module(workflow_file)
-    if hasattr(mod, "run"):
-        mod.run(launcher)
-    elif hasattr(mod, "create_workflow"):
-        launcher.create_workflow(getattr(mod, "create_workflow"))
-        launcher.initialize()
-        launcher.run()
-    else:
-        print(f"{workflow_file}: defines neither run(launcher) nor "
-              "create_workflow(launcher)", file=sys.stderr)
-        return 2
+    launcher = Launcher(
+        backend=args.backend, seed=args.seed, snapshot=args.snapshot,
+        dp=args.dp, master_address=args.master_address,
+        listen_address=args.listen_address, multihost=args.multihost,
+        plotters=args.plotters, status_server=args.status_server,
+        profile=args.profile, verbose=args.verbose)
+    try:
+        drive_workflow(launcher, workflow_file)
+    except RuntimeError as e:
+        if "defines neither" in str(e):
+            print(str(e), file=sys.stderr)
+            return 2
+        raise
     return 0
 
 
-def run_optimizer(args, workflow_file: str) -> int:
+def _ga_worker_count(args) -> int:
+    if args.ga_workers:
+        return max(1, args.ga_workers)
+    # the TPU chip is a single-client resource: genome evaluations on
+    # it must serialize; CPU evaluations parallelize across cores
+    if args.backend in ("numpy", "cpu"):
+        import os
+        return min(4, max(1, (os.cpu_count() or 2) // 2))
+    return 1
+
+
+def run_optimizer(args, workflow_file: str, config_files, overrides) \
+        -> int:
     """GA mode (reference: veles --optimize): genes are Tune(...)
     markers in the config tree; fitness is the best validation error
-    count of a full (short) training run."""
+    of a full (short) training run.  Each genome runs in its OWN
+    subprocess (veles_tpu/genetics/worker.py) — isolating the global
+    ``root`` mutation and any crash — fanned out over --ga-workers;
+    --ga-state checkpoints every generation and resumes."""
+    import json
+    import subprocess
+    from concurrent.futures import ThreadPoolExecutor
+
     from veles_tpu.config import root
-    from veles_tpu.genetics import (GeneticOptimizer, find_tunes,
-                                    substitute_tunes)
+    from veles_tpu.genetics import GeneticOptimizer, find_tunes
+    from veles_tpu.logger import setup_logging
+
+    # no Launcher in this process (the device must stay unclaimed for
+    # the workers), so logging is configured directly
+    setup_logging(10 if args.verbose else 20)
 
     tunes = find_tunes(root)
     if not tunes:
@@ -127,32 +160,37 @@ def run_optimizer(args, workflow_file: str) -> int:
         return 2
     pop_s, _, gen_s = args.optimize.partition(":")
     pop, gen = int(pop_s), int(gen_s or 3)
+    workers = _ga_worker_count(args)
 
-    def evaluate(values):
-        substitute_tunes(root, values)
-        launcher = Launcher(backend=args.backend, seed=args.seed,
-                            verbose=args.verbose)
-        mod = load_workflow_module(workflow_file)
-        if hasattr(mod, "run"):
-            mod.run(launcher)
-        elif hasattr(mod, "create_workflow"):
-            launcher.create_workflow(getattr(mod, "create_workflow"))
-            launcher.initialize()
-            launcher.run()
-        else:
-            raise RuntimeError(
-                f"{workflow_file}: defines neither run(launcher) nor "
-                "create_workflow(launcher)")
-        d = launcher.workflow.decision
-        err = d.min_valid_error
-        if err == float("inf"):
-            err = d.min_train_error
-        return err
+    base_cmd = [sys.executable, "-m", "veles_tpu.genetics.worker",
+                workflow_file, *config_files, *overrides,
+                "-b", args.backend, "-s", str(args.seed)]
 
-    opt = GeneticOptimizer(evaluate, tunes, population=pop,
-                           generations=gen)
+    def evaluate_one(values) -> float:
+        cmd = base_cmd + ["--values", json.dumps(values)]
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=args.ga_eval_timeout)
+            if res.returncode != 0:
+                raise RuntimeError(
+                    f"worker rc={res.returncode}: "
+                    f"{res.stderr.strip().splitlines()[-1:]!r}")
+            return float(json.loads(
+                res.stdout.strip().splitlines()[-1])["fitness"])
+        except Exception as e:  # noqa: BLE001 — bad genes score inf
+            print(f"--optimize: genome {values} failed: {e}",
+                  file=sys.stderr)
+            return float("inf")
+
+    def evaluate_many(values_list):
+        with ThreadPoolExecutor(workers) as pool:
+            return list(pool.map(evaluate_one, values_list))
+
+    opt = GeneticOptimizer(evaluate_one, tunes, population=pop,
+                           generations=gen,
+                           evaluate_many=evaluate_many,
+                           state_path=args.ga_state)
     best, fitness = opt.run()
-    import json
     import math
     if not math.isfinite(fitness):
         print("--optimize: every evaluation failed (fitness inf); "

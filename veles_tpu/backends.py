@@ -54,6 +54,30 @@ class NumpyDevice(Device):
     backend_name = "numpy"
 
 
+def _enable_persistent_compile_cache() -> None:
+    """Point XLA at an on-disk executable cache (idempotent).
+
+    The big fused train-step programs take minutes to compile through
+    the tunneled TPU platform; the persistent cache makes every later
+    process (reruns of bench.py, GA workers, the driver) load them in
+    milliseconds.  Opt out with VELES_TPU_NO_COMPILE_CACHE=1; relocate
+    with VELES_TPU_COMPILE_CACHE_DIR.
+    """
+    import os
+    if os.environ.get("VELES_TPU_NO_COMPILE_CACHE"):
+        return
+    path = os.environ.get(
+        "VELES_TPU_COMPILE_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "veles_tpu",
+                     "xla_cache"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
+
 class JaxDevice(Device):
     """An XLA device (TPU in production; CPU for tests/simulation).
 
@@ -69,6 +93,7 @@ class JaxDevice(Device):
                  ordinal: int = 0, compute_dtype: Any = None) -> None:
         super().__init__()
         import jax
+        _enable_persistent_compile_cache()
         self._jax = jax
         devices = jax.devices(platform) if platform else jax.devices()
         self.jax_device = devices[ordinal]

@@ -320,6 +320,23 @@ def _class_templates(rng: np.random.Generator, n_classes: int,
     return out.astype(np.float32)
 
 
+#: OPT-IN one-entry cache of the last LARGE generated dataset
+#: (``VELES_TPU_SYNTH_CACHE=1``, set by bench.py): the benchmark builds
+#: the identical ImageNet-scale set twice (resident + streaming
+#: workflows) and regeneration is minutes of single-core work.  Opt-in
+#: because the cache retains a duplicate multi-GB copy for the process
+#: lifetime — ordinary training runs must not pay that.  Callers must
+#: treat the returned arrays as read-only — every in-tree consumer
+#: copies (loaders ``np.concatenate`` the splits).  Small (test-sized)
+#: sets are never cached.
+_synth_cache: dict = {}
+_SYNTH_CACHE_MIN_BYTES = 256 * 2 ** 20
+
+
+def _synth_cache_enabled() -> bool:
+    return bool(os.environ.get("VELES_TPU_SYNTH_CACHE"))
+
+
 def synthetic_classification(
         n_train: int, n_valid: int, shape: Tuple[int, ...],
         n_classes: int = 10, noise: float = 0.4, max_shift: int = 2,
@@ -330,26 +347,43 @@ def synthetic_classification(
     sample = circular-shifted class template + gaussian noise, values
     squashed to [0, 1].  Returns (train, valid, test-or-None).
     """
+    key = (n_train, n_valid, tuple(shape), n_classes, noise,
+           max_shift, seed, n_test)
+    hit = _synth_cache.get(key) if _synth_cache_enabled() else None
+    if hit is not None:
+        return hit
     rng = np.random.default_rng(seed)
     templates = _class_templates(rng, n_classes, shape)
 
     def make(n: int) -> Split:
         y = rng.integers(0, n_classes, n).astype(np.int32)
-        x = templates[y]
+        x = templates[y]  # fancy indexing: a fresh array, safe in-place
         if max_shift > 0:
             sh, sw = (rng.integers(-max_shift, max_shift + 1, (2, n)))
             for i in range(n):  # per-sample circular shift
                 x[i] = np.roll(x[i], (sh[i], sw[i]), axis=(0, 1))
-        x = x + noise * rng.standard_normal(x.shape).astype(np.float32)
-        x = 1.0 / (1.0 + np.exp(-x))  # squash into (0,1) like pixel data
+        g = rng.standard_normal(x.shape, dtype=np.float32)
+        np.multiply(g, np.float32(noise), out=g)
+        x += g
+        del g
+        # squash into (0,1) like pixel data: sigmoid, in place
+        np.negative(x, out=x)
+        np.exp(x, out=x)
+        x += 1.0
+        np.reciprocal(x, out=x)
         if len(shape) == 2:
             x = x[..., 0] if x.shape[-1] == 1 else x
-        return x.astype(np.float32), y
+        return np.ascontiguousarray(x, np.float32), y
 
     train = make(n_train)
     valid = make(n_valid)
     test = make(n_test) if n_test else None
-    return train, valid, test
+    result = (train, valid, test)
+    nbytes = sum(s[0].nbytes for s in result if s is not None)
+    if _synth_cache_enabled() and nbytes >= _SYNTH_CACHE_MIN_BYTES:
+        _synth_cache.clear()  # hold at most one giant set
+        _synth_cache[key] = result
+    return result
 
 
 def _main(argv=None) -> int:

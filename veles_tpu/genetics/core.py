@@ -100,10 +100,20 @@ class GeneticOptimizer(Logger):
                  elite: int = 2,
                  mutation_rate: float = 0.25,
                  mutation_sigma: float = 0.15,
-                 rng_stream: str = "genetics") -> None:
+                 rng_stream: str = "genetics",
+                 evaluate_many: Optional[Callable[
+                     [List[Dict[str, Any]]], List[float]]] = None,
+                 state_path: Optional[str] = None) -> None:
         if not tunes:
             raise ValueError("no Tune(...) markers found to optimize")
         self.evaluate = evaluate
+        #: batch evaluator — N genomes at once (subprocess fan-out);
+        #: None = sequential in-process map over ``evaluate``
+        self._evaluate_many = evaluate_many
+        #: per-generation checkpoint file; run() resumes from it when
+        #: it exists (reference parity: Genetics "spawns many workflow
+        #: runs" and long GA runs must survive restarts)
+        self.state_path = state_path
         self.tunes = tunes
         self.paths = sorted(tunes)
         self.population = max(population, 2 + elite)
@@ -176,10 +186,78 @@ class GeneticOptimizer(Logger):
             self.warning("evaluation failed for %s: %s", values, e)
             return float("inf")
 
+    def _fitness_many(self, genomes: np.ndarray) -> np.ndarray:
+        if self._evaluate_many is None:
+            return np.array([self._fitness(g) for g in genomes],
+                            np.float64)
+        try:
+            fits = self._evaluate_many(
+                [self._decode(g) for g in genomes])
+            return np.asarray(fits, np.float64)
+        except Exception as e:  # noqa: BLE001 — same contract as
+            # _fitness: failures score inf, never abort the run
+            self.warning("batch evaluation failed (%s); falling back "
+                         "to per-genome evaluation", e)
+            return np.array([self._fitness(g) for g in genomes],
+                            np.float64)
+
+    # -- checkpoint / resume -------------------------------------------
+
+    def _save_state(self, gen: int, pop: np.ndarray,
+                    fits: np.ndarray) -> None:
+        """Atomic per-generation checkpoint: next generation to run,
+        its (already evaluated) population, the full history, and the
+        GA RNG state — a resumed run continues bit-identically."""
+        if not self.state_path:
+            return
+        import json
+        import os
+        state = {
+            "paths": self.paths,
+            "generation": gen,
+            "population": pop.tolist(),
+            "fits": fits.tolist(),
+            "history": [[(f, v) for f, v in g] for g in self.history],
+            "rng_state": self.rng.bit_generator.state,
+        }
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.state_path)
+
+    def _load_state(self):
+        import json
+        import os
+        if not self.state_path or not os.path.exists(self.state_path):
+            return None
+        with open(self.state_path) as f:
+            state = json.load(f)
+        if state["paths"] != self.paths:
+            raise ValueError(
+                f"GA state file {self.state_path} was written for "
+                f"genes {state['paths']}, current config has "
+                f"{self.paths} — remove the stale state file")
+        self.history = [[(float(f), v) for f, v in g]
+                        for g in state["history"]]
+        self.rng.bit_generator.state = state["rng_state"]
+        return (int(state["generation"]),
+                np.asarray(state["population"], np.float64),
+                np.asarray(state["fits"], np.float64))
+
+    # -- the loop ------------------------------------------------------
+
     def run(self) -> Tuple[Dict[str, Any], float]:
-        pop = self._initial_population()
-        fits = np.array([self._fitness(g) for g in pop])
-        for gen in range(self.generations):
+        resumed = self._load_state()
+        if resumed is not None:
+            start_gen, pop, fits = resumed
+            self.info("resumed GA at generation %d from %s",
+                      start_gen, self.state_path)
+        else:
+            start_gen = 0
+            pop = self._initial_population()
+            fits = self._fitness_many(pop)
+            self._save_state(0, pop, fits)
+        for gen in range(start_gen, self.generations):
             order = np.argsort(fits)
             pop, fits = pop[order], fits[order]
             self.history.append([(float(f), self._decode(g))
@@ -195,8 +273,9 @@ class GeneticOptimizer(Logger):
             new = np.asarray(nxt)
             new_fits = np.concatenate([
                 fits[:self.elite],
-                [self._fitness(g) for g in new[self.elite:]]])
+                self._fitness_many(new[self.elite:])])
             pop, fits = new, new_fits
+            self._save_state(gen + 1, pop, fits)
         order = np.argsort(fits)
         best = self._decode(pop[order[0]])
         self.info("GA done: best fitness %.4f with %s",

@@ -1,0 +1,59 @@
+"""One-genome evaluation worker (reference parity: veles/genetics/
+spawns a process per workflow run — SURVEY.md §3.1 Genetics).
+
+``python -m veles_tpu.genetics.worker workflow.py [config.py ...]
+--values '<json {path: value}>' [-b BACKEND] [-s SEED]``
+
+Runs ONE full training with the Tune markers substituted and prints a
+single JSON line ``{"fitness": <best validation error>}`` on stdout.
+The process boundary is the isolation: the global ``root`` mutation,
+jit caches, and any crash stay in this process — the GA parent only
+sees the fitness (or a dead worker, scored inf).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="veles_tpu.genetics.worker")
+    p.add_argument("files", nargs="+")
+    p.add_argument("--values", required=True,
+                   help="JSON {tune_path: value}")
+    p.add_argument("-b", "--backend", default="auto")
+    p.add_argument("-s", "--seed", type=int, default=1234)
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    from veles_tpu.config import parse_overrides, root
+    from veles_tpu.genetics import substitute_tunes
+    from veles_tpu.launcher import (Launcher, apply_config_file,
+                                    drive_workflow, workflow_fitness)
+
+    overrides = [a for a in args.files
+                 if a.startswith("root.") and "=" in a]
+    workflow_file, *config_files = [a for a in args.files
+                                    if a not in overrides]
+    for cf in config_files:
+        apply_config_file(cf)
+    parse_overrides(overrides)
+    substitute_tunes(root, json.loads(args.values))
+
+    launcher = Launcher(backend=args.backend, seed=args.seed,
+                        verbose=args.verbose)
+    try:
+        drive_workflow(launcher, workflow_file)
+    except RuntimeError as e:
+        if "defines neither" in str(e):
+            print(str(e), file=sys.stderr)
+            return 2
+        raise
+    print(json.dumps({"fitness": workflow_fitness(launcher.workflow)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -207,3 +207,30 @@ def apply_config_file(path: str) -> None:
     with open(path) as f:
         code = compile(f.read(), path, "exec")
     exec(code, glb)
+
+
+def drive_workflow(launcher, workflow_file: str) -> None:
+    """Load a workflow module and drive it through the launcher — the
+    one place the run(launcher) / create_workflow(launcher) module
+    contract is interpreted (CLI main and GA workers both call this)."""
+    mod = load_workflow_module(workflow_file)
+    if hasattr(mod, "run"):
+        mod.run(launcher)
+    elif hasattr(mod, "create_workflow"):
+        launcher.create_workflow(getattr(mod, "create_workflow"))
+        launcher.initialize()
+        launcher.run()
+    else:
+        raise RuntimeError(
+            f"{workflow_file}: defines neither run(launcher) nor "
+            "create_workflow(launcher)")
+
+
+def workflow_fitness(workflow) -> float:
+    """The GA fitness of a finished workflow: best validation error,
+    falling back to best train error for valid-less configs."""
+    d = workflow.decision
+    err = d.min_valid_error
+    if err == float("inf"):
+        err = d.min_train_error
+    return err

@@ -241,10 +241,26 @@ class Loader(Unit, Distributable):
 
     # -- streaming superstep assembly (device_resident=False) ----------
 
+    #: dtype the streaming pixel batch is assembled in.  The fused
+    #: runner sets it to the device compute dtype (bf16 on TPU) at
+    #: initialize: the very first in-trace op casts the input to the
+    #: compute dtype anyway, so casting HERE — in the prefetch thread,
+    #: overlapped with device compute — halves host->device bytes for
+    #: identical numerics (f32->bf16 rounds the same on host and
+    #: device).  None = keep the loader's native dtype.
+    stream_dtype = None
+
     def _assemble_superstep(self, idxs: np.ndarray):
         """(k, mb) global indices -> (k, mb, ...) batches on host."""
         k, mb = idxs.shape
         data, labels, targets = self.assemble_rows(idxs.reshape(-1))
+        if self.stream_dtype is not None and data is not None \
+                and data.dtype != self.stream_dtype:
+            # data only: the trace's first op casts the pixels to the
+            # compute dtype anyway.  Targets are NOT pre-cast — the
+            # trace consumes them uncast (f32 loss), so rounding them
+            # here would make streaming diverge from the resident path.
+            data = data.astype(self.stream_dtype)
 
         def shape_back(a):
             return None if a is None else \

@@ -76,6 +76,13 @@ class MnistLoader(SyntheticClassificationLoader):
             super().load_data()
             return
         (tx, ty), (vx, vy) = real
+        # n_train / n_valid act as caps on the real files too — a
+        # config asking for a 100-sample smoke run must not silently
+        # train on all 60k rows just because IDX files exist on disk
+        n_tr = min(self.gen_args["n_train"], len(tx))
+        n_va = min(self.gen_args["n_valid"], len(vx))
+        tx, ty = tx[:n_tr], ty[:n_tr]
+        vx, vy = vx[:n_va], vy[:n_va]
         self.class_lengths[TEST] = 0
         self.class_lengths[VALID] = len(vx)
         self.class_lengths[TRAIN] = len(tx)

@@ -122,7 +122,10 @@ class GradientDescent(GradientUnit):
     """Backward + update for any All2All variant.  One array-API
     implementation serves numpy and jax (reference: veles/znicz/gd.py)."""
 
-    def backward_from_saved(self, params, saved, err_output):
+    can_skip_err_input = True
+
+    def backward_from_saved(self, params, saved, err_output,
+                            need_err_input=True):
         x, out = saved
         err_pre = self.act_deriv(out, err_output)
         err_pre_flat = _flat(err_pre)
@@ -130,6 +133,8 @@ class GradientDescent(GradientUnit):
         grads = {"weights": xf.T @ err_pre_flat}
         if "bias" in params:
             grads["bias"] = err_pre_flat.sum(axis=0)
+        if not need_err_input:
+            return None, grads
         err_input = (err_pre_flat @ params["weights"].T).reshape(x.shape)
         return err_input, grads
 

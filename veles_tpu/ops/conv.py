@@ -147,7 +147,10 @@ class ConvRELU(Conv):
 class GradientDescentConv(GradientUnit):
     """Backward for Conv* (reference: veles/znicz/gd_conv.py)."""
 
-    def backward_from_saved(self, params, saved, err_output):
+    can_skip_err_input = True
+
+    def backward_from_saved(self, params, saved, err_output,
+                            need_err_input=True):
         x, out = saved
         err_pre = self.act_deriv(out, err_output)
         f = self.forward
@@ -160,12 +163,19 @@ class GradientDescentConv(GradientUnit):
                 f.ky, f.kx, x.shape[-1], f.n_kernels)}
             if "bias" in params:
                 grads["bias"] = err_pre.sum(axis=(0, 1, 2))
+            if not need_err_input:
+                return None, grads
             # err_input: scatter err_pre @ W^T back through the windows
             cols = (ef @ params["weights"].reshape(-1, f.n_kernels).T) \
                 .reshape(b, oh, ow, f.ky, f.kx, x.shape[-1])
             err_input = col2im(cols, x.shape, f.padding, f.sliding)
             return err_input, grads
         import jax
+
+        if not need_err_input:
+            _, vjp = jax.vjp(lambda p: f.pre_activation(p, x), params)
+            (grads,) = vjp(err_pre)
+            return None, grads
 
         def pre(p, xx):
             return f.pre_activation(p, xx)

@@ -82,10 +82,14 @@ class FusedStepRunner(AcceleratedUnit):
         #: (veles_tpu/profiling.py): train costs fwd+bwd, eval fwd only
         self.processed_images = 0.0
         self.processed_eval_images = 0.0
+        #: streaming upload double-buffer: the last two device_put
+        #: batches; the third dispatch blocks on the oldest transfer
+        from collections import deque
+        self._inflight: Any = deque()
 
     _unpicklable = AcceleratedUnit._unpicklable + (
         "_train_step", "_eval_step", "_params", "_opt", "mesh",
-        "_batch_sharding", "_acc", "_conf")
+        "_batch_sharding", "_acc", "_conf", "_inflight")
 
     # -- pytree assembly ----------------------------------------------
 
@@ -148,6 +152,8 @@ class FusedStepRunner(AcceleratedUnit):
         gds = list(self.gds)
         evaluator = self.evaluator
         n_fwd = len(forwards)
+        first_gd = next((i for i, g in enumerate(gds) if g is not None),
+                        -1)
         want_confusion = self._want_confusion()
         seed = prng.get(self.rng_stream).seed
         cd = self._resolved_dtype()
@@ -224,8 +230,17 @@ class FusedStepRunner(AcceleratedUnit):
                     f, gd = forwards[i], gds[i]
                     if gd is None:
                         continue
-                    err_in, grads = gd.backward_from_saved(
-                        cparams[f.name], residuals[i], err)
+                    if i == first_gd and gd.can_skip_err_input:
+                        # nothing consumes the chain-head err_input;
+                        # for conv1 this skips the input-dilated
+                        # transposed conv (the worst MXU op here)
+                        _, grads = gd.backward_from_saved(
+                            cparams[f.name], residuals[i], err,
+                            need_err_input=False)
+                        err_in = None
+                    else:
+                        err_in, grads = gd.backward_from_saved(
+                            cparams[f.name], residuals[i], err)
                     if grads:
                         p, v = gd.update_params(params[f.name], grads,
                                                 opt.get(gd.name, {}),
@@ -348,6 +363,12 @@ class FusedStepRunner(AcceleratedUnit):
                 f"{self.name}: loader has not loaded its data yet")
         self.streaming = not getattr(self.loader, "device_resident",
                                      True)
+        if self.streaming and self.device.is_jax:
+            # assemble streaming batches directly in the compute dtype
+            # (prefetch thread): the trace's first op is this cast
+            # anyway, and doing it host-side halves H2D bytes on the
+            # bf16 platforms where the transfer is the bottleneck
+            self.loader.stream_dtype = np.dtype(self._resolved_dtype())
         if self.mesh is not None:
             # the STATIC minibatch shape is max_minibatch_size, which
             # clamps below minibatch_size when every class is smaller —
@@ -428,7 +449,14 @@ class FusedStepRunner(AcceleratedUnit):
         """Dispatch over the loader's host-assembled superstep batch.
         The dispatch is async: while the device chews on this group the
         loader's prefetch thread is already assembling the next one —
-        that concurrency IS the input pipeline (no resident dataset)."""
+        that concurrency IS the input pipeline (no resident dataset).
+
+        The upload is an explicit double-buffered ``device_put``: at
+        most two superstep batches are in flight, so a device that
+        falls behind the host (or a slow tunnel that falls behind the
+        dispatch loop) back-pressures the loop instead of piling
+        unsent host batches into RAM without bound."""
+        import jax
         xb = ld.superstep_data
         tb = ld.superstep_targets if self._has_targets() \
             else ld.superstep_labels
@@ -437,11 +465,16 @@ class FusedStepRunner(AcceleratedUnit):
                 f"{self.name}: streaming mode but the loader produced "
                 f"no superstep batch (superstep_data/"
                 f"{'targets' if self._has_targets() else 'labels'})")
+        dst = self._batch_sharding if self.mesh is not None \
+            else self.device.jax_device
+        xb = jax.device_put(xb, dst)
+        tb = jax.device_put(tb, dst)
         if self.mesh is not None:
-            import jax
-            xb = jax.device_put(xb, self._batch_sharding)
-            tb = jax.device_put(tb, self._batch_sharding)
             mask = jax.device_put(mask, self._batch_sharding)
+        self._inflight.append((xb, tb))
+        if len(self._inflight) > 2:
+            for buf in self._inflight.popleft():
+                buf.block_until_ready()
         if train:
             self._params, self._opt, self._acc, self._conf = \
                 self._train_step(
@@ -489,6 +522,29 @@ class FusedStepRunner(AcceleratedUnit):
         self._eval_step = None
 
     # -- metric intake (Decision / zmq slave) --------------------------
+
+    def stop(self) -> None:
+        self._inflight.clear()  # release the upload double-buffer
+        super().stop()
+
+    def release_device_state(self) -> None:
+        """Drop every device buffer this runner (and its forwards)
+        holds — params, optimizer state, metric carries, the upload
+        double-buffer, and the units' param/output Vectors.  For
+        callers that build several workflows in one process (bench.py
+        measures resident then streaming): the unit graph is cyclic,
+        so dropping the workflow reference alone frees nothing until
+        a gc cycle collection, and the chip OOMs first.  Kept HERE so
+        new device-resident fields get added to the release next to
+        their definitions."""
+        self._params = self._opt = None
+        self._acc = self._conf = None
+        self._inflight.clear()
+        for f in self.forwards:
+            for v in f.param_vectors().values():
+                if v:
+                    v.reset()
+            f.output.reset()
 
     def take_class_metrics(self) -> Tuple[float, float, float,
                                           Optional[np.ndarray]]:
@@ -548,3 +604,6 @@ class FusedStepRunner(AcceleratedUnit):
         self.__dict__.pop("lr_scales", None)  # pre-rename snapshots
         self.__dict__.setdefault("lr_rates", None)
         self.__dict__.setdefault("streaming", False)
+        from collections import deque
+        if self.__dict__.get("_inflight") is None:  # dropped by pickle
+            self._inflight = deque()

@@ -4,9 +4,25 @@ Reference parity: veles/znicz/normalization.py — AlexNet's LRN:
 ``y_i = x_i / (k + alpha * sum_{j in window(i)} x_j^2) ^ beta`` with a
 channel window of size n centered on i, plus its analytic backward.
 
-Implemented once against the shared numpy/jax array API via a padded
-cumulative-sum windowed reduction — no backend-specific code; both the
-golden path and the fused trace run the same lines.
+TPU-first implementation notes (this op was HALF the AlexNet step time
+when written naively — docs/perf.md):
+
+- ``den^-0.75`` as a pow lowers to exp(log(x)) — two VPU
+  transcendentals per element over the largest activations in the
+  net, forward AND backward.  For the standard beta=3/4 it is instead
+  computed as ``r*sqrt(r)`` with ``r = rsqrt(den)`` (hardware rsqrt +
+  one sqrt); the backward's ``den^-1.75`` is ``d34 * r * r`` — zero
+  transcendentals anywhere on the hot path.
+- the windowed channel sum is a BANDED MATMUL on the jax path:
+  ``sum_window(v) = v @ B`` with ``B[c, d] = 1 iff |c - d| <= n//2``
+  (a C x C constant).  The extra FLOPs are negligible (2*C^2 per
+  pixel, <1% of the conv FLOPs around it) and they run on the MXU,
+  while the elementwise alternative (pad + n shifted adds) cost ~n
+  materialized passes over the largest activations in the net.  The
+  numpy oracle keeps the explicit shifted-adds form — an independent
+  implementation the tests compare against.
+- the forward saves ``den`` as its residual, so the backward does not
+  recompute the windowed reduction at all.
 """
 
 from __future__ import annotations
@@ -27,13 +43,46 @@ def _xp(x):
 
 def _window_sum(xp, v, n: int):
     """Sum of v over a centered channel window of size n (same shape).
-    v: (..., C)."""
+    jax: one banded matmul over the channel axis (MXU); numpy: explicit
+    shifted adds (the independent oracle)."""
     half = n // 2
-    pad = [(0, 0)] * (v.ndim - 1) + [(half + 1, half)]
-    cs = xp.cumsum(xp.pad(v, pad), axis=-1)
     c = v.shape[-1]
-    # windowed sum over [i-half, i+half]: cs[i+n] - cs[i]
-    return cs[..., n:n + c] - cs[..., 0:c]
+    if xp is not np:
+        # (v @ band)[d] = sum_off v[d - off] for eye-offsets ``off``;
+        # matching the numpy oracle's window sum_{j=-half}^{n-1-half}
+        # v[d + j] needs off = -j — exactly n taps, both parities of n
+        # (a symmetric -half..+half band would sum n+1 taps for even n)
+        band = np.zeros((c, c), np.float32)
+        for off in range(half - n + 1, half + 1):
+            band += np.eye(c, c, off, dtype=np.float32)
+        return v @ xp.asarray(band, dtype=v.dtype)
+    pad = [(0, 0)] * (v.ndim - 1) + [(half, half)]
+    vp = np.pad(v, pad)
+    out = vp[..., 0:c]
+    for i in range(1, n):
+        out = out + vp[..., i:i + c]
+    return out
+
+
+def _neg_beta_pow(xp, den, beta: float):
+    """den**(-beta) without transcendentals for the quarter-multiples
+    every real config uses (0.75 is AlexNet's; 0.5/1.0 appear in
+    variants).  Falls back to pow otherwise."""
+    if beta == 0.75:
+        r = den ** -0.5 if xp is np else _rsqrt(xp, den)
+        return r * xp.sqrt(r), r
+    if beta == 0.5:
+        r = den ** -0.5 if xp is np else _rsqrt(xp, den)
+        return r, r
+    if beta == 1.0:
+        inv = 1.0 / den
+        return inv, None
+    return den ** (-beta), None
+
+
+def _rsqrt(xp, v):
+    from jax import lax
+    return lax.rsqrt(v)
 
 
 class LRNormalizer(ForwardUnit):
@@ -57,19 +106,36 @@ class LRNormalizer(ForwardUnit):
     def apply(self, params, inputs, rng=None) -> Dict[str, Any]:
         x = inputs["input"]
         xp = _xp(x)
-        return {"output": x * self._den(xp, x) ** (-self.beta)}
+        d, _ = _neg_beta_pow(xp, self._den(xp, x), self.beta)
+        return {"output": x * d}
+
+    def apply_fwd(self, params, x, rng=None, train=True):
+        """Residual carries ``den`` so the backward never recomputes
+        the windowed reduction."""
+        xp = _xp(x)
+        den = self._den(xp, x)
+        d, _ = _neg_beta_pow(xp, den, self.beta)
+        return x * d, (x, den)
 
 
 class GDLRNormalizer(GradientUnit):
     def backward_from_saved(self, params, saved, err_output):
         f = self.forward
-        x, _y = saved
+        x, den = saved
         xp = _xp(err_output)
-        den = f._den(xp, x)
-        t = err_output * x * den ** (-f.beta - 1.0)
+        d_nb, r = _neg_beta_pow(xp, den, f.beta)      # den^-beta
+        if f.beta == 0.75 and r is not None:
+            d_nb1 = d_nb * (r * r)                    # den^-(beta+1)
+        elif f.beta == 0.5 and r is not None:
+            d_nb1 = d_nb * (r * r)
+        elif f.beta == 1.0:
+            d_nb1 = d_nb * d_nb
+        else:
+            d_nb1 = den ** (-f.beta - 1.0)
+        t = err_output * x * d_nb1
         # the window is symmetric, so the transpose windowed sum is the
         # same windowed sum
-        err_input = (err_output * den ** (-f.beta)
+        err_input = (err_output * d_nb
                      - 2.0 * f.alpha * f.beta * x
                      * _window_sum(xp, t, f.n))
         return err_input, {}

@@ -270,6 +270,14 @@ class GradientUnit(AcceleratedUnit):
             return err_output * output * (1.0 - output)
         raise ValueError(f"unknown activation_mode {mode!r}")
 
+    #: True when backward_from_saved accepts need_err_input=False and
+    #: can skip the err_input computation entirely — the fused step
+    #: passes it for the FIRST gd in the chain, whose err_input nothing
+    #: consumes (for conv1 the saving is outsized: a stride-s dgrad is
+    #: an input-dilated transposed conv, the worst-mapped op on the
+    #: MXU relative to its FLOPs).
+    can_skip_err_input = False
+
     def backward_from_saved(self, params: Dict[str, Any],
                             saved: Tuple[Any, Any], err_output: Any) \
             -> Tuple[Any, Dict[str, Any]]:
