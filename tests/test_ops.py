@@ -201,6 +201,39 @@ class TestLRN:
         u = lrn_mod.LRNormalizer(n=5)
         check_unit(u, lrn_mod.GDLRNormalizer, (2, 3, 3, 8))
 
+    def test_grads_even_window(self):
+        """Even n: the backward must use the ADJOINT window, which is
+        NOT the forward window (fd check caught a 'symmetric window'
+        shortcut that was wrong for n=4)."""
+        u = lrn_mod.LRNormalizer(n=4, alpha=3e-2)
+        check_unit(u, lrn_mod.GDLRNormalizer, (2, 3, 3, 8))
+
+    def test_pallas_kernels_match_numpy_oracle(self):
+        """The single-pass TPU kernels (interpret mode on CPU) vs the
+        numpy shifted-adds oracle, forward and backward, both real
+        channel widths (96 aligns to no lane boundary; 256 to two)."""
+        from veles_tpu.ops import lrn_pallas
+        if not lrn_pallas.available():
+            pytest.skip("no pallas in this jax build")
+        for c, n in ((96, 5), (256, 5), (96, 4)):
+            u = lrn_mod.LRNormalizer(alpha=3e-2, beta=0.75, n=n, k=2.0)
+            x = RNG.standard_normal((16, 3, 3, c)).astype(np.float32)
+            err = RNG.standard_normal(x.shape).astype(np.float32)
+            assert lrn_pallas.usable(x.shape, u.n, u.beta)
+
+            y_np, res_np = u.apply_fwd({}, x)
+            y_pl = np.asarray(lrn_pallas.lrn_fwd(
+                x, u.n, u.k, u.alpha, interpret=True))
+            np.testing.assert_allclose(y_pl, y_np, rtol=2e-5,
+                                       atol=1e-6)
+
+            gd = lrn_mod.GDLRNormalizer(forward=u)
+            ein_np, _ = gd.backward_from_saved({}, res_np, err)
+            ein_pl = np.asarray(lrn_pallas.lrn_bwd(
+                x, err, u.n, u.k, u.alpha, interpret=True))
+            np.testing.assert_allclose(ein_pl, ein_np, rtol=2e-4,
+                                       atol=1e-5)
+
     def test_jax_banded_matmul_matches_numpy_oracle_both_parities(self):
         """The jax path's banded-matmul window sum must agree with the
         independent numpy shifted-adds oracle for ODD and EVEN window

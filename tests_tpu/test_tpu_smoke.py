@@ -125,6 +125,36 @@ class TestOnDeviceRngDeterminism:
         assert runs[0] == runs[1]
 
 
+class TestPallasLrnOnChip:
+    def test_kernels_match_xla_form_at_bf16(self, tpu_device):
+        """The opt-in pallas LRN kernels vs the default XLA banded
+        form, on the real chip, bf16 inputs (docs/perf.md shootout —
+        they lose on speed at AlexNet shapes but must stay correct)."""
+        import jax.numpy as jnp
+        from veles_tpu.ops import lrn as lrn_mod
+        from veles_tpu.ops import lrn_pallas
+        if not lrn_pallas.available():
+            pytest.skip("no pallas in this jax build")
+        u = lrn_mod.LRNormalizer(alpha=3e-2, beta=0.75, n=5, k=2.0)
+        gd = lrn_mod.GDLRNormalizer(forward=u)
+        rng = np.random.default_rng(8)
+        x = jnp.asarray(rng.standard_normal((16, 7, 7, 96), np.float32),
+                        jnp.bfloat16)
+        e = jnp.asarray(rng.standard_normal((16, 7, 7, 96), np.float32),
+                        jnp.bfloat16)
+
+        y_xla, res = u.apply_fwd({}, x)
+        ei_xla, _ = gd.backward_from_saved({}, res, e)
+        y_pl = lrn_pallas.lrn_fwd(x, u.n, u.k, u.alpha)
+        ei_pl = lrn_pallas.lrn_bwd(x, e, u.n, u.k, u.alpha)
+        np.testing.assert_allclose(
+            np.asarray(y_pl, np.float32), np.asarray(y_xla, np.float32),
+            rtol=0.02, atol=0.02)
+        np.testing.assert_allclose(
+            np.asarray(ei_pl, np.float32),
+            np.asarray(ei_xla, np.float32), rtol=0.05, atol=0.05)
+
+
 class TestHonestBarrier:
     def test_sync_is_data_dependent(self, tpu_device):
         """Regression guard for the round-1 fake benchmark: fetching

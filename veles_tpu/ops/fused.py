@@ -370,6 +370,10 @@ class FusedStepRunner(AcceleratedUnit):
             # bf16 platforms where the transfer is the bottleneck
             self.loader.stream_dtype = np.dtype(self._resolved_dtype())
         if self.mesh is not None:
+            # sharded jit partitions poorly around custom-call kernels;
+            # units with hand kernels (LRN) must take their XLA form
+            for f in self.forwards:
+                f.force_xla = True
             # the STATIC minibatch shape is max_minibatch_size, which
             # clamps below minibatch_size when every class is smaller —
             # DataParallel.install() can only check minibatch_size

@@ -41,22 +41,37 @@ def _xp(x):
     return jnp
 
 
-def _window_sum(xp, v, n: int):
-    """Sum of v over a centered channel window of size n (same shape).
+def band_matrix(c: int, n: int, transpose: bool = False) -> np.ndarray:
+    """The n-tap window as a C x C 0/1 matrix:
+    ``(v @ band)[d] = sum_{j=-half}^{n-1-half} v[d+j]`` — eye-offset
+    ``off`` contributes v[d-off], hence the negated range.  EXACTLY n
+    taps for both parities of n (a symmetric -half..+half band would
+    sum n+1 taps for even n).  ``transpose=True`` gives the adjoint
+    window (taps j in [-(n-1-half), half]) — equal to the forward
+    window only for ODD n; the backward pass needs the adjoint.
+    Single source of truth for lrn.py and lrn_pallas.py."""
+    half = n // 2
+    band = np.zeros((c, c), np.float32)
+    for off in range(half - n + 1, half + 1):
+        band += np.eye(c, c, off, dtype=np.float32)
+    return np.ascontiguousarray(band.T) if transpose else band
+
+
+def _window_sum(xp, v, n: int, transpose: bool = False):
+    """Sum of v over the n-wide channel window (same shape).
     jax: one banded matmul over the channel axis (MXU); numpy: explicit
-    shifted adds (the independent oracle)."""
+    shifted adds (the independent oracle).  ``transpose`` selects the
+    adjoint window — required in the backward pass; for even n the two
+    differ (the window is centered only for odd n)."""
     half = n // 2
     c = v.shape[-1]
     if xp is not np:
-        # (v @ band)[d] = sum_off v[d - off] for eye-offsets ``off``;
-        # matching the numpy oracle's window sum_{j=-half}^{n-1-half}
-        # v[d + j] needs off = -j — exactly n taps, both parities of n
-        # (a symmetric -half..+half band would sum n+1 taps for even n)
-        band = np.zeros((c, c), np.float32)
-        for off in range(half - n + 1, half + 1):
-            band += np.eye(c, c, off, dtype=np.float32)
+        band = band_matrix(c, n, transpose)
         return v @ xp.asarray(band, dtype=v.dtype)
-    pad = [(0, 0)] * (v.ndim - 1) + [(half, half)]
+    # taps j in [-half, n-1-half] (forward) or the negated set
+    # (adjoint): left-pad by -min_tap, right-pad by max_tap
+    lo = (n - 1 - half) if transpose else half
+    pad = [(0, 0)] * (v.ndim - 1) + [(lo, n - 1 - lo)]
     vp = np.pad(v, pad)
     out = vp[..., 0:c]
     for i in range(1, n):
@@ -109,10 +124,44 @@ class LRNormalizer(ForwardUnit):
         d, _ = _neg_beta_pow(xp, self._den(xp, x), self.beta)
         return {"output": x * d}
 
+    def _use_pallas(self, x) -> bool:
+        """Whether the hand kernels (ops/lrn_pallas.py) take the hot
+        fused path.  OPT-IN via VELES_TPU_LRN_PALLAS=1: measured on a
+        v5e chip with a data-fetch barrier, XLA's banded-matmul form
+        BEATS the hand kernels at AlexNet's shapes (docs/perf.md
+        records the shootout), so the default stays XLA.  The kernels
+        remain for other shapes/platforms and as tuning
+        infrastructure.  Further requirements: a real TPU (not the
+        XLA:CPU test platform), no sharded mesh (XLA partitions
+        poorly around custom calls — ``force_xla`` is set by the
+        fused runner), beta=3/4, and a tileable shape."""
+        import os
+        if not os.environ.get("VELES_TPU_LRN_PALLAS"):
+            return False
+        if getattr(self, "force_xla", False):
+            return False
+        dev = getattr(self, "device", None)
+        if dev is None or not getattr(dev, "is_jax", False) or \
+                getattr(dev, "platform", "cpu") == "cpu":
+            return False
+        if getattr(dev, "mesh", None) is not None:
+            # a MeshJaxDevice reaches here on the eager path too, where
+            # the fused runner's force_xla loop never runs
+            return False
+        from veles_tpu.ops import lrn_pallas
+        return lrn_pallas.available() and \
+            lrn_pallas.usable(x.shape, self.n, self.beta)
+
     def apply_fwd(self, params, x, rng=None, train=True):
-        """Residual carries ``den`` so the backward never recomputes
-        the windowed reduction."""
+        """Pallas path: residual is just ``x`` (den is recomputed
+        in-kernel by the backward — cheaper than storing/loading it).
+        XLA/numpy path: residual carries ``den`` so the backward never
+        recomputes the windowed reduction."""
         xp = _xp(x)
+        if xp is not np and self._use_pallas(x):
+            from veles_tpu.ops import lrn_pallas
+            return lrn_pallas.lrn_fwd(x, self.n, self.k,
+                                      self.alpha), (x, None)
         den = self._den(xp, x)
         d, _ = _neg_beta_pow(xp, den, self.beta)
         return x * d, (x, den)
@@ -122,6 +171,10 @@ class GDLRNormalizer(GradientUnit):
     def backward_from_saved(self, params, saved, err_output):
         f = self.forward
         x, den = saved
+        if den is None:  # pallas forward: recompute den in-kernel
+            from veles_tpu.ops import lrn_pallas
+            return lrn_pallas.lrn_bwd(x, err_output, f.n, f.k,
+                                      f.alpha), {}
         xp = _xp(err_output)
         d_nb, r = _neg_beta_pow(xp, den, f.beta)      # den^-beta
         if f.beta == 0.75 and r is not None:
@@ -133,9 +186,11 @@ class GDLRNormalizer(GradientUnit):
         else:
             d_nb1 = den ** (-f.beta - 1.0)
         t = err_output * x * d_nb1
-        # the window is symmetric, so the transpose windowed sum is the
-        # same windowed sum
+        # the backward needs the ADJOINT window (transpose=True): it
+        # equals the forward window for odd n, but differs for even n
+        # (fd-checked in tests/test_ops.py — an earlier "the window is
+        # symmetric" shortcut was wrong for even n)
         err_input = (err_output * d_nb
                      - 2.0 * f.alpha * f.beta * x
-                     * _window_sum(xp, t, f.n))
+                     * _window_sum(xp, t, f.n, transpose=True))
         return err_input, {}
