@@ -125,6 +125,38 @@ class TestOnDeviceRngDeterminism:
         assert runs[0] == runs[1]
 
 
+class TestStreamingOnChip:
+    def test_bf16_streaming_trains(self, tpu_device):
+        """The host-streaming input path on the real chip: batches
+        assembled in the compute dtype by the prefetch thread,
+        double-buffered uploads, convergence on a small convnet."""
+        prng.seed_all(1234)
+        gd = {"learning_rate": 0.02, "gradient_moment": 0.9}
+        w = StandardWorkflow(
+            loader_factory=lambda wf: SyntheticClassificationLoader(
+                wf, name="loader", minibatch_size=64, n_train=1024,
+                n_valid=256, shape=(32, 32, 3), n_classes=10, seed=777,
+                max_resident_bytes=0),
+            layers=[
+                {"type": "conv_relu",
+                 "->": {"n_kernels": 16, "kx": 5, "ky": 5,
+                        "padding": 2}, "<-": gd},
+                {"type": "max_pooling", "->": {"kx": 2, "ky": 2},
+                 "<-": {}},
+                {"type": "softmax", "->": {"output_sample_shape": 10},
+                 "<-": gd}],
+            decision_config={"max_epochs": 3},
+            superstep=4, name="StreamSmoke")
+        w.initialize(device=tpu_device)
+        assert w.fused.streaming
+        assert w.loader.stream_dtype == np.dtype("bfloat16")
+        w.run()
+        hist = [h["error_pct"] for h in w.decision.history
+                if h["class"] == "validation"]
+        assert hist[-1] < hist[0], hist
+        assert len(w.fused._inflight) <= 2
+
+
 class TestPallasLrnOnChip:
     def test_kernels_match_xla_form_at_bf16(self, tpu_device):
         """The opt-in pallas LRN kernels vs the default XLA banded
