@@ -208,6 +208,16 @@ class TestLRN:
         u = lrn_mod.LRNormalizer(n=4, alpha=3e-2)
         check_unit(u, lrn_mod.GDLRNormalizer, (2, 3, 3, 8))
 
+    def test_band_matrix_is_window_adjoint(self):
+        """band_matrix(transpose=True) must be the exact matrix
+        transpose (the adjoint of the window operator) for both
+        parities — the backward pass depends on it."""
+        for n in (3, 4, 5, 6):
+            b = lrn_mod.band_matrix(12, n)
+            bt = lrn_mod.band_matrix(12, n, transpose=True)
+            np.testing.assert_array_equal(bt, b.T)
+            assert b.sum(axis=0).max() == n  # interior taps
+
     def test_pallas_kernels_match_numpy_oracle(self):
         """The single-pass TPU kernels (interpret mode on CPU) vs the
         numpy shifted-adds oracle, forward and backward, both real

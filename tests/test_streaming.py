@@ -248,3 +248,57 @@ class TestStreamingImages:
         rows, labels, _ = ld.assemble_rows(np.arange(4))
         assert decodes == []                # sliced, not re-decoded
         np.testing.assert_array_equal(rows, ld.original_data.mem[:4])
+
+
+class TestStreamDtypeAndRelease:
+    def test_synth_cache_opt_in_only(self, monkeypatch, tmp_path):
+        """The large-dataset memo must stay OFF for ordinary runs (it
+        retains a duplicate multi-GB copy) and ON under the bench's
+        env opt-in."""
+        from veles_tpu import datasets
+        monkeypatch.setattr(datasets, "_SYNTH_CACHE_MIN_BYTES", 1024)
+        datasets._synth_cache.clear()
+        args = dict(n_train=64, n_valid=0, shape=(4, 4, 3), seed=5)
+
+        monkeypatch.delenv("VELES_TPU_SYNTH_CACHE", raising=False)
+        a, _, _ = datasets.synthetic_classification(**args)
+        b, _, _ = datasets.synthetic_classification(**args)
+        assert a[0] is not b[0] and not datasets._synth_cache
+
+        monkeypatch.setenv("VELES_TPU_SYNTH_CACHE", "1")
+        c, _, _ = datasets.synthetic_classification(**args)
+        d, _, _ = datasets.synthetic_classification(**args)
+        assert d[0] is c[0]
+        np.testing.assert_array_equal(np.asarray(a[0]),
+                                      np.asarray(c[0]))
+        datasets._synth_cache.clear()
+
+    def test_release_device_state_drops_buffers(self):
+        """bench.py relies on this to fit two workflows' HBM on one
+        chip: after release, the runner and its units hold no device
+        arrays and a later run() rebuilds them."""
+        w = build_mlp(streaming=True)
+        w.initialize(device=JaxDevice(platform="cpu"))
+        w.loader.run()
+        w.fused.run()
+        assert w.fused._params is not None
+        w.fused.release_device_state(sync=True)
+        assert w.fused._params is None and w.fused._acc is None
+        assert not w.fused._inflight
+        for f in w.forwards:
+            assert f.output.devmem is None
+        # the runner recovers: next firing re-uploads the synced host
+        # params and keeps training from where it stopped
+        before = {f.name: np.asarray(
+            f.param_vectors()["weights"].mem).copy()
+            for f in w.forwards}
+        w.loader.run()
+        w.fused.run()
+        assert w.fused._params is not None
+        after = {n: np.asarray(w.fused._params[n]["weights"])
+                 for n in before}
+        for n in before:  # params moved (training continued) ...
+            assert np.abs(after[n] - before[n]).max() > 0
+            # ... from the SYNCED values, not a re-init (SGD step is
+            # small; re-init would differ by O(weight scale))
+            assert np.abs(after[n] - before[n]).max() < 0.2

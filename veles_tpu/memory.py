@@ -58,6 +58,17 @@ class Vector:
         self._devmem = None
         self.mem = new_mem
 
+    def drop_devmem(self) -> None:
+        """Free the HBM copy only.  Any VALID host copy survives; if
+        the device held the only valid copy the vector truly reads as
+        unallocated — the stale host array is dropped too, so nothing
+        (pickling, plotters, __bool__ guards) can serve outdated
+        values.  Callers that need the data must map_read() first."""
+        self._devmem = None
+        self._valid &= HOST
+        if not self._valid:
+            self._mem = None
+
     def __bool__(self) -> bool:
         return self._mem is not None or self._devmem is not None
 

@@ -254,12 +254,22 @@ class FusedStepRunner(AcceleratedUnit):
                 return (new_params, new_opt, acc, conf, rc + 1), None
             return body
 
+        # scan unroll for the train loop: >1 lets XLA schedule one
+        # minibatch's weight updates behind the next one's matmuls at
+        # the cost of an unroll-times bigger program (slower compile).
+        # Measured on v5e (docs/perf.md): no win at AlexNet scale, so
+        # the default stays 1; the knob remains for smaller nets where
+        # per-step overheads matter more.
+        import os
+        unroll = max(1, int(os.environ.get("VELES_TPU_SCAN_UNROLL",
+                                           "1")))
+
         def train_step(params, opt, acc, conf, dataset, target_store,
                        indices, mask, lr_rates, rng_counter):
             body = train_body(dataset, target_store)
             (params, opt, acc, conf, _), _ = lax.scan(
                 body, (params, opt, acc, conf, rng_counter),
-                (indices, mask, lr_rates))
+                (indices, mask, lr_rates), unroll=unroll)
             return params, opt, acc, conf
 
         def train_step_stream(params, opt, acc, conf, xb, tb, mask,
@@ -267,7 +277,7 @@ class FusedStepRunner(AcceleratedUnit):
             body = train_body(None, None)
             (params, opt, acc, conf, _), _ = lax.scan(
                 body, (params, opt, acc, conf, rng_counter),
-                (xb, tb, mask, lr_rates))
+                (xb, tb, mask, lr_rates), unroll=unroll)
             return params, opt, acc, conf
 
         def eval_step(params, acc, conf, dataset, target_store,
@@ -531,24 +541,41 @@ class FusedStepRunner(AcceleratedUnit):
         self._inflight.clear()  # release the upload double-buffer
         super().stop()
 
-    def release_device_state(self) -> None:
+    def release_device_state(self, sync: bool = False) -> None:
         """Drop every device buffer this runner (and its forwards)
         holds — params, optimizer state, metric carries, the upload
-        double-buffer, and the units' param/output Vectors.  For
+        double-buffer, and the units' param/output device copies.  For
         callers that build several workflows in one process (bench.py
         measures resident then streaming): the unit graph is cyclic,
         so dropping the workflow reference alone frees nothing until
         a gc cycle collection, and the chip OOMs first.  Kept HERE so
         new device-resident fields get added to the release next to
-        their definitions."""
+        their definitions.
+
+        ``sync=True`` pulls the live params to host first, so the
+        runner keeps training correctly after the release (a later
+        run() re-uploads); ``sync=False`` skips the device->host fetch
+        for workflows about to be discarded."""
+        if sync:
+            self.sync_params_to_vectors()
+            for gd in self.gds:
+                if gd is not None:  # momentum must survive the release
+                    for v in gd.accumulated_grads.values():
+                        v.map_read()
         self._params = self._opt = None
         self._acc = self._conf = None
         self._inflight.clear()
         for f in self.forwards:
             for v in f.param_vectors().values():
                 if v:
-                    v.reset()
-            f.output.reset()
+                    v.drop_devmem()
+            f.output.drop_devmem()
+        for gd in self.gds:
+            if gd is None:
+                continue
+            # optimizer velocity is as large as the params themselves
+            for v in gd.accumulated_grads.values():
+                v.drop_devmem()
 
     def take_class_metrics(self) -> Tuple[float, float, float,
                                           Optional[np.ndarray]]:
