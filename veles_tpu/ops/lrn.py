@@ -21,12 +21,18 @@ when written naively — docs/perf.md):
   materialized passes over the largest activations in the net.  The
   numpy oracle keeps the explicit shifted-adds form — an independent
   implementation the tests compare against.
-- the forward saves ``den`` as its residual, so the backward does not
-  recompute the windowed reduction at all.
+- residual policy: by default the forward saves ``den`` so the
+  backward skips the windowed reduction; the opt-in variants (pallas
+  kernels via VELES_TPU_LRN_PALLAS, x-only residual via
+  VELES_TPU_LRN_RECOMPUTE) save just ``x`` and re-derive ``den`` in
+  the backward.  Measured on a v5e the two policies tie — fwd and bwd
+  share one scan body, so XLA schedules the residual freely either
+  way (docs/perf.md).
 """
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict
 
 import numpy as np
@@ -135,7 +141,6 @@ class LRNormalizer(ForwardUnit):
         XLA:CPU test platform), no sharded mesh (XLA partitions
         poorly around custom calls — ``force_xla`` is set by the
         fused runner), beta=3/4, and a tileable shape."""
-        import os
         if not os.environ.get("VELES_TPU_LRN_PALLAS"):
             return False
         if getattr(self, "force_xla", False):
@@ -153,10 +158,13 @@ class LRNormalizer(ForwardUnit):
             lrn_pallas.usable(x.shape, self.n, self.beta)
 
     def apply_fwd(self, params, x, rng=None, train=True):
-        """Pallas path: residual is just ``x`` (den is recomputed
-        in-kernel by the backward — cheaper than storing/loading it).
-        XLA/numpy path: residual carries ``den`` so the backward never
-        recomputes the windowed reduction."""
+        """Residual policy: pallas path and the recompute variant save
+        only ``x`` — the backward re-derives ``den`` (a cheap banded
+        MXU matmul) instead of storing/loading an f32 array the size
+        of the largest activations in the net.  Default XLA path
+        carries ``den``; VELES_TPU_LRN_RECOMPUTE=1 switches (both
+        measured in docs/perf.md — fwd+bwd live in ONE scan body, so
+        XLA schedules the residual freely either way)."""
         xp = _xp(x)
         if xp is not np and self._use_pallas(x):
             from veles_tpu.ops import lrn_pallas
@@ -164,6 +172,8 @@ class LRNormalizer(ForwardUnit):
                                       self.alpha), (x, None)
         den = self._den(xp, x)
         d, _ = _neg_beta_pow(xp, den, self.beta)
+        if xp is not np and os.environ.get("VELES_TPU_LRN_RECOMPUTE"):
+            return x * d, (x, None)
         return x * d, (x, den)
 
 
@@ -171,11 +181,13 @@ class GDLRNormalizer(GradientUnit):
     def backward_from_saved(self, params, saved, err_output):
         f = self.forward
         x, den = saved
-        if den is None:  # pallas forward: recompute den in-kernel
-            from veles_tpu.ops import lrn_pallas
-            return lrn_pallas.lrn_bwd(x, err_output, f.n, f.k,
-                                      f.alpha), {}
         xp = _xp(err_output)
+        if den is None:  # x-only residual: recompute den here
+            if f._use_pallas(x):
+                from veles_tpu.ops import lrn_pallas
+                return lrn_pallas.lrn_bwd(x, err_output, f.n, f.k,
+                                          f.alpha), {}
+            den = f._den(xp, x)
         d_nb, r = _neg_beta_pow(xp, den, f.beta)      # den^-beta
         if f.beta == 0.75 and r is not None:
             d_nb1 = d_nb * (r * r)                    # den^-(beta+1)
