@@ -7,7 +7,7 @@ import pytest
 from veles_tpu.backends import JaxDevice, NumpyDevice
 from veles_tpu.launcher import Launcher
 from veles_tpu.models import (alexnet, cifar10, kohonen, mnist, mnist7,
-                              mnist_ae)
+                              mnist_ae, wine)
 
 
 class FakeLauncher:
@@ -144,3 +144,20 @@ class TestKohonen:
             results.append(w.forward.weights.map_read().copy())
         np.testing.assert_allclose(results[0], results[1],
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestWine:
+    def test_runs_and_learns_jax(self, dev):
+        fl = FakeLauncher()
+        w = wine.create_workflow(fl)
+        w.initialize(device=dev)
+        w.run()
+        assert w.decision.epoch_error_pct[1] < 30.0, \
+            w.decision.epoch_error_pct
+
+    def test_runs_numpy(self):
+        fl = FakeLauncher()
+        w = wine.create_workflow(fl, decision={"max_epochs": 2})
+        w.initialize(device=NumpyDevice())
+        w.run()
+        assert len(w.decision.history) == 4

@@ -15,7 +15,7 @@ from veles_tpu.parallel import (DataParallel, MeshJaxDevice, batch_sharding,
                                 make_mesh, replicated_sharding)
 
 
-def build_workflow(mb=48, max_epochs=2, momentum=0.9):
+def build_workflow(mb=48, max_epochs=2, momentum=0.9, **loader_kw):
     prng.seed_all(777)
     train, valid, _ = synthetic_classification(
         480, 192, (12, 12, 1), n_classes=10, seed=42)
@@ -23,7 +23,8 @@ def build_workflow(mb=48, max_epochs=2, momentum=0.9):
           "gradient_moment": momentum}
     return StandardWorkflow(
         loader_factory=lambda w: ArrayLoader(
-            w, train=train, valid=valid, minibatch_size=mb, name="loader"),
+            w, train=train, valid=valid, minibatch_size=mb,
+            name="loader", **loader_kw),
         layers=[
             {"type": "all2all_tanh", "->": {"output_sample_shape": 32},
              "<-": gd},
@@ -148,3 +149,31 @@ class TestLauncherDP:
         assert isinstance(launcher.device, MeshJaxDevice)
         launcher.run()
         assert len(valid_history(launcher.workflow)) == 1
+
+
+class TestStreamingDataParallel:
+    def test_streaming_dp_matches_single_device_streaming(self):
+        """The combination: host-streaming batches (no HBM-resident
+        dataset) entering the SHARDED fused step.  _run_streaming
+        device_puts the assembled superstep batch with the mesh's
+        batch sharding; trajectory must match single-device streaming
+        (the dp story cannot be resident-only — ImageNet-scale data is
+        why streaming exists)."""
+        w1 = build_workflow(max_resident_bytes=0)
+        w1.initialize(device=JaxDevice(platform="cpu"))
+        assert w1.fused.streaming
+        w1.run()
+
+        w8 = build_workflow(max_resident_bytes=0)
+        dp = DataParallel(w8, 8)
+        w8.initialize(device=dp.install())
+        assert w8.fused.streaming
+        w8.run()
+
+        h1, h8 = valid_history(w1), valid_history(w8)
+        assert len(h1) == len(h8) == 2
+        for a, b in zip(h1, h8):
+            assert abs(a["loss"] - b["loss"]) < 5e-3, (a, b)
+            assert abs(a["n_err"] - b["n_err"]) <= 3, (a, b)
+        wts = w8.fused._params[w8.forwards[0].name]["weights"]
+        assert wts.is_fully_replicated

@@ -1,0 +1,52 @@
+"""Wine tabular-classification workflow — the reference's smallest
+demo (reference: veles/znicz/samples/Wine: UCI Wine, 178 samples x 13
+chemical features, 3 cultivars; FullBatch -> All2AllTanh(8) ->
+All2AllSoftmax(3); SURVEY.md §3.2 samples row "others (Wine, …)").
+
+No dataset ships in this image (no network — SURVEY.md §0), so the
+loader uses the deterministic synthetic tabular stand-in: 13 features
+as a (13, 1) "image" the MLP flattens, 3 classes, sized like the real
+set.  Real data placed as arrays can be fed through ArrayLoader with
+the same layers.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.loader.synthetic import SyntheticClassificationLoader
+from veles_tpu.models import model_config
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+DEFAULTS = {
+    "loader": {"minibatch_size": 10, "n_train": 140, "n_valid": 38,
+               "shape": (13, 1), "n_classes": 3, "noise": 0.6,
+               "max_shift": 0, "seed": 1317},
+    "layers": [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+         "<-": {"learning_rate": 0.3, "weight_decay": 0.0}},
+        {"type": "softmax", "->": {"output_sample_shape": 3},
+         "<-": {"learning_rate": 0.3, "weight_decay": 0.0}},
+    ],
+    "decision": {"max_epochs": 30, "fail_iterations": 100},
+    "snapshotter": None,
+}
+
+
+def create_workflow(launcher, **overrides):
+    cfg = model_config("wine", DEFAULTS).todict()
+    cfg.update(overrides)
+    w = StandardWorkflow(
+        loader_factory=lambda wf: SyntheticClassificationLoader(
+            wf, name="loader", **cfg["loader"]),
+        layers=cfg["layers"],
+        loss_function="softmax",
+        decision_config=cfg["decision"],
+        snapshotter_config=cfg.get("snapshotter"),
+        name="WineWorkflow")
+    launcher.workflow = w
+    return w
+
+
+def run(launcher):
+    launcher.create_workflow(create_workflow)
+    launcher.initialize()
+    launcher.run()
