@@ -1,11 +1,17 @@
-"""Roofline check for the fused AlexNet train step: XLA's own
-cost_analysis (FLOPs + bytes accessed) vs measured step time.
+"""Roofline check for the fused AlexNet train step.
 
-Prints the compiler's numbers, the implied compute-bound and
-HBM-bound floors, and where the measured time sits.  Distinguishes
-"the kernels are inefficient" (measured >> both floors) from "we are
-at the HBM roof" (measured ~= bytes/bandwidth) — the decision input
-for docs/perf.md.
+Compares the measured steady-state superstep time against compute- and
+HBM-bound floors derived from TWO flop/byte sources:
+
+- the analytic per-layer count (veles_tpu/profiling.py) — trusted;
+- XLA's own ``compiled.cost_analysis()`` — reported for reference but
+  NOT trusted on TPU: it undercounts convolution FLOPs after fusion
+  (measured ~0.8 GFLOP/image where the analytic count is ~2.3 fwd /
+  6.8 train — docs/perf.md), so floors derived from it are labeled.
+
+Distinguishes "the kernels are inefficient" (measured >> both floors)
+from "we are at a roof" (measured ~= floor) — the decision input for
+docs/perf.md.
 """
 
 from __future__ import annotations
@@ -18,15 +24,14 @@ import numpy as np
 
 sys.path.insert(0, ".")
 
-V5E_PEAK_FLOPS = 197e12      # bf16
-V5E_HBM_BW = 819e9           # bytes/sec
+V5E_HBM_BW = 819e9           # bytes/sec (xla-floor reference only)
 
 
 def main():
     mb = int(sys.argv[1]) if len(sys.argv) > 1 else 512
     ss = int(sys.argv[2]) if len(sys.argv) > 2 else 8
 
-    from veles_tpu import prng
+    from veles_tpu import profiling, prng
     from veles_tpu.backends import make_device
     from veles_tpu.loader.synthetic import SyntheticClassificationLoader
     from veles_tpu.models.alexnet import alexnet_layers
@@ -51,63 +56,65 @@ def main():
         loader.run()
         fused.run()
 
-    fire()
-    np.asarray(fused._acc)
-
-    # measured steady-state superstep time
-    n = 6
-    t0 = time.perf_counter()
-    for _ in range(n):
+    for _ in range(3):
         fire()
-    np.asarray(fused._acc)
-    dt = (time.perf_counter() - t0) / n
+    np.asarray(fused._acc)     # the honest barrier (bench.py contract)
 
-    cost = {}
+    # steady-state superstep time: median of repeats, amortized firings
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(8):
+            fire()
+        np.asarray(fused._acc)
+        times.append((time.perf_counter() - t0) / 8)
+    dt = float(np.median(times))
+
+    n_img = mb * ss
+    analytic = profiling.model_flops_per_sample(w.forwards)["train"]
+    a_flops = analytic * n_img
+    # peak resolved from the ACTUAL device (None on CPU/unknown —
+    # same helper bench.py trusts), not a hardcoded v5e constant
+    peak = profiling.device_peak_flops(device.jax_device)
+    u = profiling.mfu(n_img / dt, analytic, device.jax_device)
+    out = {"mb": mb, "superstep": ss,
+           "measured_superstep_sec": round(dt, 4),
+           "images_per_sec": round(n_img / dt, 1),
+           "analytic_train_gflops_per_image": round(analytic / 1e9, 3),
+           "analytic_compute_floor_sec":
+               round(a_flops / peak, 4) if peak else None,
+           "mfu": round(u, 4) if u is not None else None}
+
     try:
-        # the jitted step was executed: pull its compiled cost analysis
-        entries = fused._train_step._cache_size()  # noqa: F841 probe
-    except Exception:
-        pass
-    try:
-        lowered = None
-        for key in ("cost_analysis",):
-            pass
-        # AOT route: trace again with the live args via .lower()
         ld = loader
         args = (fused._params, fused._opt, fused._acc, fused._conf,
                 ld.original_data.unmap(), fused._target_store(),
                 ld.superstep_indices, ld.superstep_mask,
                 fused._lr_rates_array(ld.superstep_indices.shape[0]),
                 fused._rng_counter)
-        compiled = fused._train_step.lower(*args).compile()
-        ca = compiled.cost_analysis()
+        ca = fused._train_step.lower(*args).compile().cost_analysis()
         if isinstance(ca, list):
             ca = ca[0]
-        cost = {k: ca[k] for k in
-                ("flops", "bytes accessed", "transcendentals")
-                if k in ca}
-    except Exception as e:  # noqa: BLE001
-        cost = {"error": str(e)}
-
-    out = {"mb": mb, "superstep": ss,
-           "measured_superstep_sec": round(dt, 4),
-           "images_per_sec": round(mb * ss / dt, 1)}
-    if "flops" in cost:
-        flops = float(cost["flops"])
-        nbytes = float(cost.get("bytes accessed", 0))
-        out.update({
-            "xla_tflops_per_superstep": round(flops / 1e12, 3),
-            "xla_gbytes_per_superstep": round(nbytes / 1e9, 3),
-            "compute_floor_sec": round(flops / V5E_PEAK_FLOPS, 4),
-            "hbm_floor_sec": round(nbytes / V5E_HBM_BW, 4),
-            "transcendentals": cost.get("transcendentals"),
-        })
-        out["bound"] = ("hbm" if out["hbm_floor_sec"] >
-                        out["compute_floor_sec"] else "compute")
-        floor = max(out["compute_floor_sec"], out["hbm_floor_sec"])
-        out["efficiency_vs_floor"] = round(floor / dt, 3)
-    else:
-        out["cost_analysis"] = cost
+        if "flops" not in ca:
+            # no FLOP count at all on this backend: emit the raw dict,
+            # derive nothing (a zero would fire the undercount note)
+            out["cost_analysis"] = {k: ca[k] for k in sorted(ca)[:12]}
+        else:
+            flops = float(ca["flops"])
+            nbytes = float(ca.get("bytes accessed", 0))
+            out.update({
+                "xla_tflops_per_superstep": round(flops / 1e12, 3),
+                "xla_gbytes_per_superstep": round(nbytes / 1e9, 3),
+                "xla_hbm_floor_sec": round(nbytes / V5E_HBM_BW, 4),
+                "xla_transcendentals": ca.get("transcendentals"),
+                "xla_flops_vs_analytic": round(flops / a_flops, 3),
+            })
+            if flops < 0.5 * a_flops:
+                out["note"] = ("xla cost_analysis undercounts fused "
+                               "conv FLOPs on TPU; trust the analytic "
+                               "floor")
+    except Exception as e:  # noqa: BLE001 — reference data only
+        out["cost_analysis_error"] = str(e)
     print(json.dumps(out))
 
 
