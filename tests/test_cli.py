@@ -69,6 +69,23 @@ class TestCLI:
         r = run_cli([workflow_file, config_file, "-b", "numpy"])
         assert r.returncode == 0, r.stderr[-2000:]
 
+    def test_log_events_jsonl_sink(self, workflow_file, config_file,
+                                   tmp_path):
+        """--log-events FILE appends every run event as one JSON line
+        (the reference's MongoDB event-sink parity, file-shaped)."""
+        events = tmp_path / "events.jsonl"
+        r = run_cli([workflow_file, config_file, "-b", "numpy",
+                     "--log-events", str(events)])
+        assert r.returncode == 0, r.stderr[-2000:]
+        lines = [json.loads(ln) for ln in
+                 events.read_text().splitlines()]
+        assert lines, "no events recorded"
+        assert all({"ts", "level", "unit", "message"} <= set(e)
+                   for e in lines)
+        # the run's lifecycle is in the durable record
+        assert any("epoch" in e["message"].lower() or
+                   "workflow" in e["unit"].lower() for e in lines)
+
     def test_dump_config(self, workflow_file, config_file):
         r = run_cli([workflow_file, config_file, "--dump-config"])
         assert r.returncode == 0, r.stderr[-2000:]

@@ -140,3 +140,67 @@ class TestForgeStrictManifest:
         with pytest.raises(ValueError, match="not listed in the "
                                              "manifest"):
             ForgePackage.install(evil, str(tmp_path / "store"))
+
+
+class TestForgeMarketplace:
+    def test_publish_list_fetch_install_roundtrip(self, tmp_path):
+        """The HTTP marketplace (reference: VelesForge upload/download)
+        round-trips a package: publish -> list -> fetch -> install."""
+        import threading
+
+        from veles_tpu import forge
+
+        wf = tmp_path / "wf.py"
+        wf.write_text("def run(launcher):\n    pass\n")
+        cfg = tmp_path / "cfg.py"
+        cfg.write_text("root.demo.n = 1\n")
+        pkg = str(tmp_path / "demo.vpkg")
+        forge.ForgePackage.pack(pkg, "demo", str(wf), [str(cfg)],
+                                version="1.2.0", author="t")
+
+        server = forge.make_forge_server(str(tmp_path / "store"),
+                                         port=0, host="127.0.0.1")
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            m = forge.publish(pkg, url)
+            assert m["name"] == "demo" and m["file"] == "demo.vpkg"
+            got = forge.fetch("demo", url, str(tmp_path / "dl"))
+            inst = forge.ForgePackage.install(
+                got, str(tmp_path / "inst"))
+            assert inst["version"] == "1.2.0"
+            import os
+            assert os.path.isfile(os.path.join(inst["root"], "wf.py"))
+            with pytest.raises(FileNotFoundError, match="available"):
+                forge.fetch("nope", url)
+        finally:
+            server.shutdown()
+            t.join(timeout=5)
+
+    def test_upload_rejects_garbage_and_bad_names(self, tmp_path):
+        import threading
+        from urllib.request import Request, urlopen
+        from urllib.error import HTTPError
+
+        from veles_tpu import forge
+
+        server = forge.make_forge_server(str(tmp_path / "store"),
+                                         port=0, host="127.0.0.1")
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            for path in ("/forge/upload/../../etc.vpkg",
+                         "/forge/upload/notatar.vpkg",
+                         "/forge/upload/wrongext.txt"):
+                req = Request(url + path, data=b"not a tarball")
+                with pytest.raises(HTTPError):
+                    urlopen(req, timeout=10)
+            import os
+            store = tmp_path / "store"
+            assert not any(os.scandir(store)), \
+                "rejected uploads must leave nothing in the store"
+        finally:
+            server.shutdown()
+            t.join(timeout=5)

@@ -138,6 +138,50 @@ class TestConv:
         u = conv_mod.Conv(n_kernels=8, kx=11, ky=11, sliding=4)
         assert u.output_shape_for((1, 227, 227, 3)) == (1, 55, 55, 8)
 
+    @pytest.mark.parametrize("geom", [
+        # (kx, ky, pad, stride, in_shape) — AlexNet conv1 miniature,
+        # stride not dividing kernel, rectangular stride, with padding
+        (11, 11, 0, 4, (2, 31, 31, 3)),
+        (5, 5, 0, 3, (2, 17, 17, 2)),
+        (3, 4, (1, 2), (2, 3), (2, 11, 13, 3)),
+        (2, 2, 0, 2, (1, 8, 8, 4)),
+    ])
+    def test_space_to_depth_exact(self, geom, monkeypatch):
+        """The s2d rewrite must match lax.conv bit-for-bit-ish (f32
+        reassociation only) in forward AND in both vjp cotangents."""
+        import jax
+        kx, ky, pad, stride, shp = geom
+        u = conv_mod.Conv(n_kernels=5, kx=kx, ky=ky, padding=pad,
+                          sliding=stride)
+        assert u._s2d_eligible(shp[-1])
+        wshape = u.param_shapes(shp)["weights"]
+        w = RNG.standard_normal(wshape).astype(np.float32)
+        x = RNG.standard_normal(shp).astype(np.float32)
+
+        def run(s2d):
+            monkeypatch.setenv("VELES_TPU_CONV_S2D", "1" if s2d else "0")
+            y, vjp = jax.vjp(
+                lambda ww, xx: u.pre_activation({"weights": ww}, xx),
+                jnp.asarray(w), jnp.asarray(x))
+            ct = jnp.asarray(
+                RNG2.standard_normal(y.shape).astype(np.float32))
+            dw, dx = vjp(ct)
+            return np.asarray(y), np.asarray(dw), np.asarray(dx)
+
+        RNG2 = np.random.default_rng(0)
+        ref = run(False)
+        RNG2 = np.random.default_rng(0)
+        got = run(True)
+        for a, b in zip(ref, got):
+            assert a.shape == b.shape
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+    def test_s2d_ineligible_for_unit_stride_or_many_channels(self):
+        assert not conv_mod.Conv(n_kernels=4, kx=3, ky=3,
+                                 sliding=1)._s2d_eligible(3)
+        assert not conv_mod.Conv(n_kernels=4, kx=5, ky=5,
+                                 sliding=4)._s2d_eligible(64)
+
 
 class TestPooling:
     def test_max(self):

@@ -68,6 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--status-server", default=None,
                    help="POST per-epoch status to this web_status "
                         "dashboard (http://host:port)")
+    p.add_argument("--log-events", default=None, metavar="FILE",
+                   help="append every log record to FILE as JSON "
+                        "lines (the reference's run-event DB sink, "
+                        "file-shaped)")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("--dump-config", action="store_true",
                    help="print the effective config tree and exit")
@@ -98,6 +102,12 @@ def main(argv=None) -> int:
         from veles_tpu.config import root
         root.print_()
         return 0
+
+    if args.log_events:
+        import atexit
+
+        from veles_tpu.logger import add_jsonl_sink
+        atexit.register(add_jsonl_sink(args.log_events))
 
     if args.optimize:
         # NO Launcher here: constructing one acquires the device, and

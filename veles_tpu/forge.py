@@ -21,6 +21,13 @@ CLI:
     python -m veles_tpu.forge info    pkg.vpkg
     python -m veles_tpu.forge install pkg.vpkg [dest_dir]
     python -m veles_tpu.forge list    [store_dir]
+
+Marketplace (the reference's VelesForge service, stdlib-http-shaped —
+a shared store any host on the cluster can publish to / fetch from):
+
+    python -m veles_tpu.forge serve   [store_dir] [--port 8188]
+    python -m veles_tpu.forge publish pkg.vpkg http://host:8188
+    python -m veles_tpu.forge fetch   NAME http://host:8188 [dest_dir]
 """
 
 from __future__ import annotations
@@ -96,7 +103,12 @@ class ForgePackage(Logger):
     @staticmethod
     def read_manifest(pkg_path: str) -> Dict[str, Any]:
         with tarfile.open(pkg_path, "r:gz") as tar:
-            member = tar.getmember(MANIFEST)
+            # pack() writes the manifest first: tar.next() avoids
+            # decompressing the whole archive (snapshots can be GBs)
+            # just to list it.  Foreign archives fall back to a scan.
+            member = tar.next()
+            if member is None or member.name != MANIFEST:
+                member = tar.getmember(MANIFEST)
             manifest = json.loads(tar.extractfile(member).read())
         if manifest.get("format_version", 0) > FORMAT_VERSION:
             raise ValueError(
@@ -176,6 +188,179 @@ class ForgePackage(Logger):
         return out
 
 
+# -- marketplace over HTTP (reference: VelesForge upload/download) ----
+
+def _safe_pkg_name(name: str) -> str:
+    base = os.path.basename(name)
+    if base != name or not base.endswith((".vpkg", ".tar.gz")) \
+            or base.startswith("."):
+        raise ValueError(f"bad package file name: {name!r}")
+    return base
+
+
+def make_forge_server(store_dir: str, port: int = 0,
+                      host: str = "0.0.0.0"):
+    """HTTP marketplace over a package store directory.
+
+    GET  /forge/list        -> JSON array of manifests (+ "file")
+    GET  /forge/pkg/<file>  -> package bytes
+    POST /forge/upload/<file> (body = package bytes) -> manifest JSON
+
+    Returns the ``ThreadingHTTPServer`` (caller: ``serve_forever`` or
+    a thread + ``shutdown``).  Uploads are staged and must parse as a
+    manifested package before they land in the store.  There is no
+    authentication (the reference's Forge was an open marketplace):
+    bind ``host`` to a trusted interface.
+    """
+    import tempfile
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    os.makedirs(store_dir, exist_ok=True)
+
+    class Handler(BaseHTTPRequestHandler):
+        timeout = 60  # a stalled upload must free its thread + staging
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _json(self, code: int, obj: Any) -> None:
+            blob = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def do_GET(self):
+            if self.path == "/forge/list":
+                return self._json(200, ForgePackage.list_store(store_dir))
+            if self.path.startswith("/forge/pkg/"):
+                try:
+                    fn = _safe_pkg_name(self.path[len("/forge/pkg/"):])
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                full = os.path.join(store_dir, fn)
+                if not os.path.isfile(full):
+                    return self._json(404, {"error": f"no such package "
+                                                     f"{fn}"})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/gzip")
+                self.send_header("Content-Length",
+                                 str(os.path.getsize(full)))
+                self.end_headers()
+                with open(full, "rb") as f:
+                    import shutil
+                    shutil.copyfileobj(f, self.wfile)
+                return None
+            return self._json(404, {"error": "unknown endpoint"})
+
+        def do_POST(self):
+            if not self.path.startswith("/forge/upload/"):
+                return self._json(404, {"error": "unknown endpoint"})
+            try:
+                fn = _safe_pkg_name(self.path[len("/forge/upload/"):])
+            except ValueError as e:
+                return self._json(400, {"error": str(e)})
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except (TypeError, ValueError):
+                length = -1
+            if not 0 < length <= 1 << 31:
+                return self._json(400, {"error": "bad content length"})
+            fd, staging = tempfile.mkstemp(dir=store_dir,
+                                           prefix=".upload-")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    remaining = length
+                    while remaining:
+                        chunk = self.rfile.read(min(1 << 20, remaining))
+                        if not chunk:
+                            raise ValueError("truncated upload")
+                        f.write(chunk)
+                        remaining -= len(chunk)
+                manifest = ForgePackage.read_manifest(staging)
+                os.replace(staging, os.path.join(store_dir, fn))
+            except Exception as e:  # noqa: BLE001 — report to client
+                try:
+                    os.unlink(staging)
+                except OSError:
+                    pass
+                return self._json(400, {"error": f"rejected: {e}"})
+            manifest["file"] = fn
+            return self._json(200, manifest)
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def _http_error_detail(e) -> str:
+    """Extract the server's JSON ``error`` field from an HTTPError."""
+    try:
+        return json.loads(e.read()).get("error", str(e))
+    except Exception:  # noqa: BLE001 — best-effort detail
+        return str(e)
+
+
+def publish(pkg_path: str, url: str) -> Dict[str, Any]:
+    """Upload a package to a forge server; returns its manifest.
+    The body is streamed from disk (snapshots can be GBs)."""
+    from urllib.error import HTTPError
+    from urllib.request import Request, urlopen
+
+    fn = _safe_pkg_name(os.path.basename(pkg_path))
+    size = os.path.getsize(pkg_path)
+    with open(pkg_path, "rb") as f:
+        req = Request(f"{url.rstrip('/')}/forge/upload/{fn}", data=f,
+                      headers={"Content-Type": "application/gzip",
+                               "Content-Length": str(size)})
+        try:
+            with urlopen(req, timeout=60) as resp:
+                return json.loads(resp.read())
+        except HTTPError as e:
+            raise RuntimeError(
+                f"publish refused: {_http_error_detail(e)}") from e
+
+
+def fetch(name: str, url: str, dest_dir: str = ".") -> str:
+    """Download the newest package named ``name``; returns its path.
+    Streamed to a staging file and manifest-validated before the final
+    name appears — a failed download leaves nothing behind."""
+    import shutil
+    import tempfile
+    from urllib.request import urlopen
+
+    base = url.rstrip("/")
+    with urlopen(f"{base}/forge/list", timeout=60) as resp:
+        listing = json.loads(resp.read())
+    matches = [m for m in listing if m.get("name") == name]
+    if not matches:
+        raise FileNotFoundError(
+            f"no package named {name!r} on {url} "
+            f"(available: {sorted({m.get('name') for m in listing})})")
+    best = max(matches,
+               key=lambda m: tuple(
+                   int(p) if p.isdigit() else 0
+                   for p in str(m.get("version", "0")).split(".")))
+    os.makedirs(dest_dir, exist_ok=True)
+    out_path = os.path.join(dest_dir, best["file"])
+    fd, staging = tempfile.mkstemp(dir=dest_dir, prefix=".fetch-")
+    f = os.fdopen(fd, "wb")  # own the fd before anything can raise
+    try:
+        with urlopen(f"{base}/forge/pkg/{best['file']}",
+                     timeout=300) as r:
+            shutil.copyfileobj(r, f)
+        f.close()
+        ForgePackage.read_manifest(staging)  # validate or raise
+        os.replace(staging, out_path)
+    except Exception:
+        f.close()
+        try:
+            os.unlink(staging)
+        except OSError:
+            pass
+        raise
+    return out_path
+
+
 def main(argv=None) -> int:
     import argparse
     import sys
@@ -202,6 +387,19 @@ def main(argv=None) -> int:
     ins.add_argument("dest", nargs="?", default="forge_store")
     ls = sub.add_parser("list")
     ls.add_argument("store", nargs="?", default="forge_store")
+    srv = sub.add_parser("serve")
+    srv.add_argument("store", nargs="?", default="forge_store")
+    srv.add_argument("--port", type=int, default=8188)
+    srv.add_argument("--host", default="0.0.0.0",
+                     help="interface to bind (no auth — bind a "
+                          "trusted one; default all)")
+    pub = sub.add_parser("publish")
+    pub.add_argument("pkg")
+    pub.add_argument("url")
+    ft = sub.add_parser("fetch")
+    ft.add_argument("name")
+    ft.add_argument("url")
+    ft.add_argument("dest", nargs="?", default=".")
     args = p.parse_args(argv)
 
     if args.cmd == "pack":
@@ -220,6 +418,19 @@ def main(argv=None) -> int:
         for m in ForgePackage.list_store(args.store):
             print(f"{m['file']}: {m['name']} {m['version']} "
                   f"({m.get('description', '')})")
+    elif args.cmd == "serve":
+        server = make_forge_server(args.store, args.port, args.host)
+        print(f"forge marketplace on port "
+              f"{server.server_address[1]}, store={args.store}")
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            server.shutdown()
+    elif args.cmd == "publish":
+        m = publish(args.pkg, args.url)
+        print(f"published {m['file']}: {m['name']} {m['version']}")
+    elif args.cmd == "fetch":
+        print(fetch(args.name, args.url, args.dest))
     return 0
 
 

@@ -2,15 +2,19 @@
 
 Reference parity: veles/logger.py — a ``Logger`` mixin every unit
 inherits, giving per-instance named loggers with colored console output.
-The optional MongoDB event sink of the reference is out of scope (no
-database in the TPU environment); an in-process event hook list covers
-the same observability need.
+The reference's optional MongoDB event sink (a durable, queryable
+record of run events) has no database in the TPU environment; its
+equivalent here is ``add_jsonl_sink`` — every log record appended as
+one JSON line to a file (grep/jq replace mongo queries) — built on the
+generic ``event_hooks`` seam.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import sys
+import time
 from typing import Callable, List
 
 _COLORS = {
@@ -37,8 +41,40 @@ class _ColorFormatter(logging.Formatter):
 
 class _HookHandler(logging.Handler):
     def emit(self, record: logging.LogRecord) -> None:
-        for hook in event_hooks:
+        # copy: a failing hook may unregister itself mid-iteration
+        for hook in list(event_hooks):
             hook(record)
+
+
+def add_jsonl_sink(path: str) -> Callable[[], None]:
+    """Append every ``veles.*`` log record to ``path`` as one JSON
+    line (the reference's MongoDB event sink, file-shaped).  Returns a
+    detach function that unregisters the hook and closes the file."""
+    f = open(path, "a", buffering=1)
+
+    def hook(record: logging.LogRecord) -> None:
+        try:
+            f.write(json.dumps({
+                "ts": round(record.created or time.time(), 3),
+                "level": record.levelname,
+                "unit": record.name,
+                "message": record.getMessage(),
+            }) + "\n")
+        except (ValueError, OSError):
+            # closed file / full or vanished disk: the event record is
+            # an observability aid — it must never take down the run.
+            # Drop the sink and keep training on the console handler.
+            if hook in event_hooks:
+                event_hooks.remove(hook)
+
+    event_hooks.append(hook)
+
+    def detach() -> None:
+        if hook in event_hooks:
+            event_hooks.remove(hook)
+        f.close()
+
+    return detach
 
 
 _configured = False
