@@ -1,0 +1,73 @@
+"""docs/guide.md §3's custom-unit example, executed — pins the
+public extension API (ForwardUnit/GradientUnit subclassing + registry)
+so the documentation cannot drift from the code."""
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.backends import make_device
+from veles_tpu.loader.fullbatch import ArrayLoader
+from veles_tpu.ops.nn_units import ForwardUnit, GradientUnit
+from veles_tpu.ops import registry
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+
+class Scale(ForwardUnit):
+    def output_shape_for(self, s):
+        return tuple(s)
+
+    def param_shapes(self, s):
+        return {"weights": (s[-1],)}
+
+    def apply(self, params, inputs, rng=None):
+        return {"output": inputs["input"] * params["weights"]}
+
+
+class GDScale(GradientUnit):
+    def backward_from_saved(self, params, saved, err_output):
+        x, _out = saved
+        return (err_output * params["weights"],
+                {"weights": (err_output * x).sum(0)})
+
+
+def _build():
+    prng.seed_all(7)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((256, 8)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    return StandardWorkflow(
+        loader_factory=lambda wf: ArrayLoader(
+            wf, name="loader", train=(x[64:], y[64:]),
+            valid=(x[:64], y[:64]), minibatch_size=32),
+        layers=[
+            {"type": "scale", "->": {}, "<-": {"learning_rate": 0.05}},
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": {"learning_rate": 0.05}},
+            {"type": "softmax", "->": {"output_sample_shape": 2},
+             "<-": {"learning_rate": 0.05}},
+        ],
+        loss_function="softmax",
+        decision_config={"max_epochs": 6},
+        name="GuideScale")
+
+
+class TestGuideCustomUnit:
+    def setup_method(self):
+        if "scale" not in registry.forward_registry:
+            registry.register("scale", Scale, GDScale)
+
+    def test_trains_fused_jax(self):
+        w = _build()
+        w.initialize(device=make_device("cpu"))
+        w.run()
+        hist = [h for h in w.decision.history
+                if h["class"] == "validation"]
+        assert hist[-1]["error_pct"] < hist[0]["error_pct"]
+
+    def test_trains_numpy_golden(self):
+        w = _build()
+        w.initialize(device=make_device("numpy"))
+        w.run()
+        hist = [h for h in w.decision.history
+                if h["class"] == "validation"]
+        assert hist[-1]["error_pct"] < hist[0]["error_pct"]
