@@ -94,6 +94,16 @@ def measure(name: str, mb: int, firings: int) -> dict:
     rate = img / dt
     u = profiling.mfu(rate, flops["train"], device.jax_device)
     w.stop()
+    # release this variant's HBM (dataset + params + carries) before
+    # the next one builds, or variants accumulate and the chip OOMs
+    # (same lesson as bench.py's resident->streaming handoff)
+    w.fused.release_device_state()
+    w.loader.original_data.reset()
+    w.loader.original_labels.reset()
+    w.loader.original_targets.reset()
+    import gc
+    del w, loader, fused
+    gc.collect()
     return {"variant": name, "images_per_sec": round(rate, 1),
             "train_gflops_per_image": round(flops["train"] / 1e9, 3),
             "mfu": round(u, 4) if u else None,
@@ -101,6 +111,9 @@ def measure(name: str, mb: int, firings: int) -> dict:
 
 
 def main():
+    import os
+    # every variant loads the identical synthetic dataset — memoize it
+    os.environ.setdefault("VELES_TPU_SYNTH_CACHE", "1")
     mb = int(sys.argv[1]) if len(sys.argv) > 1 else 512
     firings = int(sys.argv[2]) if len(sys.argv) > 2 else 16
     names = sys.argv[3:] or ["base", "no_lrn", "avg_pool", "no_dropout",
