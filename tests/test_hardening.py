@@ -178,6 +178,68 @@ class TestForgeMarketplace:
             server.shutdown()
             t.join(timeout=5)
 
+    def test_fetch_rejects_malicious_listing_filename(self, tmp_path,
+                                                      monkeypatch):
+        """A compromised server's listing can claim "file":
+        "../../x.vpkg" — fetch() must refuse before any path is built
+        (round-3 ADVICE medium: arbitrary-path write on the client)."""
+        import io
+        import json as _json
+
+        from veles_tpu import forge
+
+        listing = _json.dumps([{"name": "demo", "version": "1.0.0",
+                                "file": "../../escape.vpkg"}]).encode()
+
+        class _Resp(io.BytesIO):
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def fake_urlopen(url, timeout=None):
+            assert url.endswith("/forge/list"), \
+                "fetch must not request a package with an unsafe name"
+            return _Resp(listing)
+
+        monkeypatch.setattr("urllib.request.urlopen", fake_urlopen)
+        with pytest.raises(ValueError, match="bad package file name"):
+            forge.fetch("demo", "http://evil:1", str(tmp_path / "dl"))
+        assert not (tmp_path.parent / "escape.vpkg").exists()
+
+    def test_store_listing_survives_bad_manifest_member(self, tmp_path):
+        """A crafted archive whose manifest.json member is a directory
+        must not crash list_store for everyone (round-3 ADVICE low)."""
+        import tarfile
+
+        from veles_tpu import forge
+
+        store = tmp_path / "store"
+        store.mkdir()
+        wf = tmp_path / "wf.py"
+        wf.write_text("def run(launcher):\n    pass\n")
+        good = str(store / "good.vpkg")
+        forge.ForgePackage.pack(good, "good", str(wf), [])
+        bad = str(store / "bad.vpkg")
+        with tarfile.open(bad, "w:gz") as tar:
+            info = tarfile.TarInfo("manifest.json")
+            info.type = tarfile.DIRTYPE
+            tar.addfile(info)
+        listed = forge.ForgePackage.list_store(str(store))
+        assert [m["name"] for m in listed] == ["good"]
+
+    def test_server_defaults_to_loopback(self, tmp_path):
+        """The unauthenticated upload endpoint must not bind all
+        interfaces unless explicitly asked (round-3 ADVICE low)."""
+        from veles_tpu import forge
+
+        server = forge.make_forge_server(str(tmp_path / "store"), port=0)
+        try:
+            assert server.server_address[0] == "127.0.0.1"
+        finally:
+            server.server_close()
+
     def test_upload_rejects_garbage_and_bad_names(self, tmp_path):
         import threading
         from urllib.request import Request, urlopen

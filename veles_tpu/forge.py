@@ -109,6 +109,13 @@ class ForgePackage(Logger):
             member = tar.next()
             if member is None or member.name != MANIFEST:
                 member = tar.getmember(MANIFEST)
+            # a crafted archive can name a directory/link "manifest.json";
+            # extractfile() then returns None — reject as a bad manifest
+            # (ValueError is what list_store tolerates) instead of
+            # crashing every store listing with AttributeError
+            if not member.isfile():
+                raise ValueError(
+                    f"bad manifest member in {pkg_path!r}: not a file")
             manifest = json.loads(tar.extractfile(member).read())
         if manifest.get("format_version", 0) > FORMAT_VERSION:
             raise ValueError(
@@ -199,7 +206,7 @@ def _safe_pkg_name(name: str) -> str:
 
 
 def make_forge_server(store_dir: str, port: int = 0,
-                      host: str = "0.0.0.0"):
+                      host: str = "127.0.0.1"):
     """HTTP marketplace over a package store directory.
 
     GET  /forge/list        -> JSON array of manifests (+ "file")
@@ -340,12 +347,17 @@ def fetch(name: str, url: str, dest_dir: str = ".") -> str:
                key=lambda m: tuple(
                    int(p) if p.isdigit() else 0
                    for p in str(m.get("version", "0")).split(".")))
+    # the listing's "file" field is SERVER-SUPPLIED: validate it before
+    # it reaches os.path.join or the download URL, or a malicious forge
+    # can answer "../../x.vpkg" and write outside dest_dir (mirrors the
+    # server-side check on upload)
+    fn = _safe_pkg_name(best["file"])
     os.makedirs(dest_dir, exist_ok=True)
-    out_path = os.path.join(dest_dir, best["file"])
+    out_path = os.path.join(dest_dir, fn)
     fd, staging = tempfile.mkstemp(dir=dest_dir, prefix=".fetch-")
     f = os.fdopen(fd, "wb")  # own the fd before anything can raise
     try:
-        with urlopen(f"{base}/forge/pkg/{best['file']}",
+        with urlopen(f"{base}/forge/pkg/{fn}",
                      timeout=300) as r:
             shutil.copyfileobj(r, f)
         f.close()
@@ -390,9 +402,10 @@ def main(argv=None) -> int:
     srv = sub.add_parser("serve")
     srv.add_argument("store", nargs="?", default="forge_store")
     srv.add_argument("--port", type=int, default=8188)
-    srv.add_argument("--host", default="0.0.0.0",
-                     help="interface to bind (no auth — bind a "
-                          "trusted one; default all)")
+    srv.add_argument("--host", default="127.0.0.1",
+                     help="interface to bind; the upload endpoint has "
+                          "no auth, so exposing beyond loopback is an "
+                          "explicit opt-in (e.g. --host 0.0.0.0)")
     pub = sub.add_parser("publish")
     pub.add_argument("pkg")
     pub.add_argument("url")
