@@ -5,8 +5,29 @@ Runs the production path — StandardWorkflow's fused jitted train step
 (forward + backward + SGD update in one XLA computation, batch rows
 gathered from the HBM-resident dataset) — on the default device (the
 real TPU chip under the driver; XLA:CPU elsewhere) and prints ONE JSON
-line.  ``vs_baseline`` is null: the reference published no number
-(BASELINE.json "published": {}, see BASELINE.md).
+line per completed phase.  ``vs_baseline`` is null: the reference
+published no number (BASELINE.json "published": {}, see BASELINE.md).
+
+Reporting contract (round-3 VERDICT next #1: the round-3 run measured
+a 49% MFU result and then LOST it to the driver's timeout because the
+single JSON print came after every phase):
+
+- The COMPLETE record is printed immediately after the resident
+  measurement, with the not-yet-measured fields null, and re-printed
+  enriched after each later phase.  The driver parses the last valid
+  line, so a timeout can only truncate enrichment — never erase the
+  headline.
+- Phases run cheapest-information-first: resident (the headline) ->
+  MNIST-conv-to-99% (seconds on chip; BASELINE's secondary metric) ->
+  streaming (minutes, link-bound on a tunneled chip).
+- The resident dataset is born ON the device
+  (loader.synthetic.DeviceSyntheticLoader): round 3 spent 619.7s of
+  the driver's budget generating ImageNet-scale pixels on a single
+  host core and tunneling them up; device generation is milliseconds.
+- The streaming phase is bounded by wall clock (BENCH_STREAM_SECONDS),
+  not a firing count, and its host-side dataset is n_base distinct
+  images tiled to full length — identical bytes moved per step,
+  a fraction of the single-core generation cost.
 
 Honesty contract (round-1 VERDICT weak #1/#2 fixes):
 
@@ -35,21 +56,33 @@ import time
 import numpy as np
 
 SUPERSTEP = int(os.environ.get("BENCH_SUPERSTEP", "8"))
+#: wall-clock cap for the whole streaming phase (measurement windows,
+#: not the build/compile), seconds
+STREAM_SECONDS = float(os.environ.get("BENCH_STREAM_SECONDS", "75"))
+#: wall-clock cap for the MNIST-conv-to-99% run, seconds
+SECONDARY_SECONDS = float(os.environ.get("BENCH_SECONDARY_SECONDS",
+                                         "240"))
 
 
 def build(mb, n_train, image, n_classes, streaming=False):
     from veles_tpu import prng
-    from veles_tpu.loader.synthetic import SyntheticClassificationLoader
+    from veles_tpu.loader.synthetic import DeviceSyntheticLoader
     from veles_tpu.models.alexnet import alexnet_layers
     from veles_tpu.ops.standard_workflow import StandardWorkflow
 
     prng.seed_all(1234)
-    lkw = {"max_resident_bytes": 0} if streaming else {}
-    w = StandardWorkflow(
-        loader_factory=lambda wf: SyntheticClassificationLoader(
+    if streaming:
+        loader_factory = lambda wf: _tiled_loader_class()(  # noqa: E731
             wf, name="loader", minibatch_size=mb, n_train=n_train,
             n_valid=0, shape=image, n_classes=n_classes, seed=227227,
-            **lkw),
+            max_resident_bytes=0)
+    else:
+        # resident: the dataset is generated in HBM by the device
+        loader_factory = lambda wf: DeviceSyntheticLoader(  # noqa: E731
+            wf, name="loader", minibatch_size=mb, n_train=n_train,
+            n_valid=0, shape=image, n_classes=n_classes, seed=227227)
+    w = StandardWorkflow(
+        loader_factory=loader_factory,
         layers=alexnet_layers(n_classes),
         loss_function="softmax",
         decision_config={"max_epochs": 10 ** 9},
@@ -57,6 +90,42 @@ def build(mb, n_train, image, n_classes, streaming=False):
         name="AlexNetBench")
     w.evaluator.compute_confusion = False
     return w
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _tiled_loader_class():
+    """Streaming-bench host dataset loader: N_BASE distinct synthetic
+    images tiled out to n_train rows.  The streaming measurement times
+    host assembly + transfer + compute — bytes moved per step are what
+    matter, and tiled rows move exactly the same bytes as distinct
+    rows while skipping minutes of single-core generation (this host:
+    1 core).  Class built lazily so importing bench.py stays free of
+    framework imports."""
+    from veles_tpu import datasets
+    from veles_tpu.loader.synthetic import SyntheticClassificationLoader
+
+    class TiledSyntheticLoader(SyntheticClassificationLoader):
+        N_BASE = 512
+
+        def load_data(self) -> None:
+            a = self.gen_args
+            n_base = min(self.N_BASE, a["n_train"])
+            (bx, by), _, _ = datasets.synthetic_classification(
+                n_base, 0, a["shape"], n_classes=a["n_classes"],
+                noise=a["noise"], max_shift=a["max_shift"],
+                seed=a["seed"])
+            n = a["n_train"]
+            reps = -(-n // n_base)
+            self.class_lengths[:] = [0, 0, n]
+            self.original_data.mem = np.tile(
+                bx, (reps,) + (1,) * (bx.ndim - 1))[:n]
+            self.original_labels.mem = np.tile(by, reps)[:n].astype(
+                np.int32)
+
+    return TiledSyntheticLoader
 
 
 def sync_images(fused) -> float:
@@ -69,14 +138,15 @@ def sync_images(fused) -> float:
     return float(fused.processed_images)
 
 
-def secondary_metric():
+def secondary_metric(max_seconds=SECONDARY_SECONDS):
     """BASELINE's secondary metric — MNIST-conv wall-clock seconds to
     99% validation accuracy — measured on real MNIST IDX files.  This
     image ships none (no network), so the deterministic synthetic
     stand-in is materialized AS IDX files first (idempotent; genuine
     pre-placed files are left untouched — datasets.generate_mnist_idx),
     and the whole real-file path (IDX parse -> loader -> fused train)
-    is what gets timed."""
+    is what gets timed.  Capped at ``max_seconds`` wall-clock and 40
+    epochs; returns None (with a stderr reason) when the cap is hit."""
     if os.environ.get("BENCH_SKIP_SECONDARY"):
         return None  # sweep/profiling runs re-measure only the primary
     from veles_tpu import datasets, prng
@@ -98,6 +168,8 @@ def secondary_metric():
     w = mnist7.create_workflow(_FL(), decision={"max_epochs": 40})
     w.initialize(device=make_device("auto"))
     orig_run = w.decision.run
+    t0 = time.perf_counter()
+    deadline = t0 + max_seconds
 
     def run_with_target():
         orig_run()
@@ -105,18 +177,26 @@ def secondary_metric():
                 if h["class"] == "validation"]
         if hist and hist[-1]["error_pct"] <= 1.0:
             w.decision.complete.set(True)
+        elif time.perf_counter() > deadline:
+            print(f"secondary metric capped at {max_seconds}s before "
+                  f"reaching 99% (best so far: "
+                  f"{min(h['error_pct'] for h in hist) if hist else '?'}"
+                  f"% err)", file=sys.stderr)
+            w.decision.complete.set(True)
     w.decision.run = run_with_target
-    t0 = time.perf_counter()
     w.run()
     dt = time.perf_counter() - t0
     hist = [h for h in w.decision.history if h["class"] == "validation"]
     reached = bool(hist) and hist[-1]["error_pct"] <= 1.0
+    w.stop()
     return round(dt, 2) if reached else None
 
 
-def measure_rate(w, firings, repeats, warmup=3):
+def measure_rate(w, firings, repeats, warmup=3, time_budget=None):
     """Median images/sec over ``repeats`` timed windows, bracketed by
-    the data-dependent metric-carry sync."""
+    the data-dependent metric-carry sync.  With ``time_budget`` (s) the
+    window size is derived from a timed probe firing so the whole
+    measurement fits the budget instead of a fixed firing count."""
     loader, fused = w.loader, w.fused
 
     def fire():
@@ -126,6 +206,17 @@ def measure_rate(w, firings, repeats, warmup=3):
     for _ in range(warmup):
         fire()
     sync_images(fused)
+    if time_budget is not None:
+        t0 = time.perf_counter()
+        fire()
+        sync_images(fused)
+        t_one = max(time.perf_counter() - t0, 1e-3)
+        # total firings that fit the remaining budget; shrink repeats
+        # before firings so one slow-link firing per window can never
+        # multiply the budget away (each window needs >= 1 firing)
+        total = max(1, int((time_budget - t_one) / t_one))
+        repeats = min(repeats, total)
+        firings = max(1, min(firings, total // repeats))
     rates = []
     for _ in range(repeats):
         images0 = sync_images(fused)
@@ -150,7 +241,8 @@ def streaming_metric(mb, n_train, device, firings, repeats):
     link, not the pipeline, bounds streaming: the honest claim is
     "streaming achieves X% of what this host can physically feed"
     (pipeline efficiency), alongside the raw ratio vs the resident
-    path.  Returns (rate, h2d_floor_rate) or None."""
+    path.  Measurement windows fit BENCH_STREAM_SECONDS of wall clock.
+    Returns (rate, h2d_floor_rate) or None."""
     if os.environ.get("BENCH_SKIP_STREAMING"):
         return None
     try:
@@ -173,7 +265,8 @@ def streaming_metric(mb, n_train, device, firings, repeats):
             puts.append(time.perf_counter() - t0)
         h2d_rate = n_img / float(np.median(puts))
         w.fused.run()   # consume the assembled batch
-        rate, _ = measure_rate(w, firings, repeats, warmup=1)
+        rate, _ = measure_rate(w, firings, repeats, warmup=1,
+                               time_budget=STREAM_SECONDS)
         w.stop()
         return rate, h2d_rate
     except Exception as e:  # noqa: BLE001 — secondary measurement
@@ -182,11 +275,14 @@ def streaming_metric(mb, n_train, device, firings, repeats):
 
 
 def main() -> None:
-    # bench builds the identical giant synthetic set twice (resident +
-    # streaming) — opt into the dataset memo (datasets._synth_cache)
+    global _TiledSyntheticLoader
+    # the streaming phase re-derives its base set from the same args —
+    # opt into the dataset memo (datasets._synth_cache)
     os.environ.setdefault("VELES_TPU_SYNTH_CACHE", "1")
     from veles_tpu import profiling
     from veles_tpu.backends import make_device
+
+    _TiledSyntheticLoader = _tiled_loader_class()
 
     # defaults = the measured-best configuration (docs/perf.md sweep):
     # mb=512 amortizes optimizer/weight traffic, superstep 8 amortizes
@@ -200,13 +296,13 @@ def main() -> None:
         print(f"[bench +{time.perf_counter() - t_start:6.1f}s] {msg}",
               file=sys.stderr, flush=True)
 
-    # n_train sized so every loader firing yields a full superstep of
-    # k=SUPERSTEP minibatches; two groups of variety when that stays
-    # small, one group otherwise (synthetic generation + HBM for a
-    # 227x227x3 f32 row is ~618 KB/image — 2x at mb=512 ss=16 would be
-    # 10 GB of host datagen for zero measurement value)
-    n_train = mb * SUPERSTEP * (2 if mb * SUPERSTEP <= 2048 else 1)
-    phase(f"building resident workflow (n_train={n_train})")
+    # one superstep group of variety: at mb=512 ss=8 that is 4096
+    # distinct 227x227x3 rows (2.5 GB in HBM) — every firing gathers a
+    # full superstep; more variety adds host/HBM cost for zero
+    # measurement value
+    n_train = mb * SUPERSTEP
+    phase(f"building resident workflow (n_train={n_train}, "
+          f"device-generated)")
     w = build(mb=mb, n_train=n_train, image=(227, 227, 3),
               n_classes=1000)
     device = make_device("auto")
@@ -220,25 +316,8 @@ def main() -> None:
     jdev = device.jax_device
     u = profiling.mfu(images_per_sec, flops["train"], jdev)
     w.stop()
-    # Release the resident workflow's HBM (dataset + params + metric
-    # carries) before the streaming build, or the two workflows'
-    # buffers coexist and the 16 GB chip OOMs.  The unit graph is
-    # cyclic, so dropping refs is not enough — collect explicitly.
-    w.fused.release_device_state()
-    w.loader.original_data.reset()
-    w.loader.original_labels.reset()
-    w.loader.original_targets.reset()
-    del w
-    import gc
-    gc.collect()
-    phase(f"resident: {images_per_sec:.0f} img/s; measuring streaming")
-    stream = streaming_metric(mb, n_train, device,
-                              max(6, firings // 4), 2)
-    stream_rate, h2d_rate = stream if stream else (None, None)
-    phase("streaming done; secondary metric (MNIST-conv to 99%)")
-    secondary = secondary_metric()
-    phase("done")
-    print(json.dumps({
+
+    record = {
         "metric": "alexnet_train_images_per_sec_per_chip",
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
@@ -251,23 +330,57 @@ def main() -> None:
         "mfu": round(u, 4) if u is not None else None,
         "device_kind": getattr(jdev, "device_kind", "unknown"),
         "runs_images_per_sec": [round(r, 2) for r in rates],
-        "streaming_images_per_sec":
-            round(stream_rate, 2) if stream_rate else None,
-        "streaming_ratio":
-            round(stream_rate / images_per_sec, 4) if stream_rate
-            else None,
+        # enrichment fields, filled by later phases; the record is
+        # COMPLETE (and re-printed) after every phase so a timeout can
+        # only ever truncate enrichment
+        "mnist_conv_time_to_99_sec": None,
+        "streaming_images_per_sec": None,
+        "streaming_ratio": None,
+        "streaming_h2d_floor_images_per_sec": None,
+        "streaming_pipeline_efficiency": None,
+    }
+
+    def emit():
+        print(json.dumps(record), flush=True)
+
+    phase(f"resident: {images_per_sec:.0f} img/s (emitting headline)")
+    emit()
+
+    # Release the resident workflow's HBM (dataset + params + metric
+    # carries) before the later phases, or the buffers coexist with the
+    # streaming workflow's and the 16 GB chip OOMs.  The unit graph is
+    # cyclic, so dropping refs is not enough — collect explicitly.
+    w.fused.release_device_state()
+    w.loader.original_data.reset()
+    w.loader.original_labels.reset()
+    w.loader.original_targets.reset()
+    del w
+    import gc
+    gc.collect()
+
+    phase("secondary metric (MNIST-conv to 99% on IDX files)")
+    record["mnist_conv_time_to_99_sec"] = secondary_metric()
+    emit()
+
+    phase("measuring streaming")
+    stream = streaming_metric(mb, n_train, device,
+                              max(6, firings // 4), 2)
+    if stream:
+        stream_rate, h2d_rate = stream
+        record["streaming_images_per_sec"] = round(stream_rate, 2)
+        record["streaming_ratio"] = round(
+            stream_rate / images_per_sec, 4)
         # what this host can physically push to the device (timed raw
-        # device_put of one superstep batch) and how close the full
+        # device_put of one superstep batch) and how close the FULL
         # pipeline gets to that bound — on a tunneled TPU the link is
         # the wall, and this pair shows whether the FRAMEWORK or the
         # LINK is leaving throughput behind (docs/perf.md)
-        "streaming_h2d_floor_images_per_sec":
-            round(h2d_rate, 2) if h2d_rate else None,
-        "streaming_pipeline_efficiency":
-            round(stream_rate / min(h2d_rate, images_per_sec), 4)
-            if stream_rate and h2d_rate else None,
-        "mnist_conv_time_to_99_sec": secondary,
-    }))
+        record["streaming_h2d_floor_images_per_sec"] = round(
+            h2d_rate, 2)
+        record["streaming_pipeline_efficiency"] = round(
+            stream_rate / min(h2d_rate, images_per_sec), 4)
+    phase("done")
+    emit()
 
 
 if __name__ == "__main__":

@@ -87,6 +87,27 @@ class TestCheckpointResume:
         assert all(b == 4 for b in batches[1:])  # pop - elite
 
 
+class TestDeviceRacePolicy:
+    """Parallel genome workers must never race to initialize an
+    exclusive TPU chip (round-3 VERDICT next #8)."""
+
+    def test_auto_parallel_falls_back_to_cpu(self):
+        from veles_tpu.__main__ import _resolve_ga_execution
+        assert _resolve_ga_execution("auto", 4) == (4, "cpu")
+
+    def test_explicit_tpu_parallel_serializes(self):
+        from veles_tpu.__main__ import _resolve_ga_execution
+        assert _resolve_ga_execution("tpu", 4) == (1, "tpu")
+        assert _resolve_ga_execution("jax", 2) == (1, "jax")
+
+    def test_cpu_and_single_worker_unchanged(self):
+        from veles_tpu.__main__ import _resolve_ga_execution
+        assert _resolve_ga_execution("cpu", 4) == (4, "cpu")
+        assert _resolve_ga_execution("numpy", 3) == (3, "numpy")
+        assert _resolve_ga_execution("auto", 1) == (1, "auto")
+        assert _resolve_ga_execution("tpu", 1) == (1, "tpu")
+
+
 @pytest.fixture
 def tuned_workflow(tmp_path):
     wf = tmp_path / "wf.py"

@@ -124,7 +124,7 @@ class TestSyntheticDatasets:
         assert set(np.unique(y)) <= set(range(10))
 
     def test_cifar_shape(self):
-        (x, y), _, _ = datasets.cifar10(50, 10)
+        (x, y), _, _ = datasets.cifar10(50, 10, force_synthetic=True)
         assert x.shape == (50, 32, 32, 3)
 
     def test_learnable(self):

@@ -68,8 +68,7 @@ class TestCifar10:
         fl = FakeLauncher()
         w = cifar10.create_workflow(
             fl, loader={"minibatch_size": 25, "n_train": 150,
-                        "n_valid": 50, "shape": (32, 32, 3),
-                        "noise": 0.5, "seed": 32323},
+                        "n_valid": 50},
             decision={"max_epochs": 3})
         w.initialize(device=dev)
         w.run()

@@ -1,0 +1,145 @@
+"""Real-file CIFAR-10 path end-to-end (round-3 VERDICT next #3):
+binary/pickle batch files written offline -> loader picks them over
+the synthetic stand-in -> the BASELINE config #3 workflow trains."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from veles_tpu import datasets, prng
+from veles_tpu.backends import JaxDevice
+from veles_tpu.config import root
+
+
+@pytest.fixture
+def cifar_dir(tmp_path):
+    base = datasets.generate_cifar10_batches(
+        str(tmp_path / "cifar10" / "cifar-10-batches-bin"),
+        n_train=500, n_test=100)
+    old = root.common.get("data_dir") if "common" in root else None
+    root.common.data_dir = str(tmp_path)
+    yield base
+    root.common.data_dir = old
+
+
+class TestBinaryRoundtrip:
+    def test_write_read(self, cifar_dir):
+        real = datasets.try_load_real_cifar10()
+        assert real is not None
+        (tx, ty), (vx, vy) = real
+        assert tx.shape == (500, 32, 32, 3) and vx.shape == \
+            (100, 32, 32, 3)
+        assert tx.dtype == np.float32 and 0.0 <= tx.min() \
+            and tx.max() <= 1.0
+        assert set(np.unique(ty)) <= set(range(10))
+        # byte-exact vs the generator's source arrays (quantized)
+        (sx, sy), (qx, qy), _ = datasets.synthetic_classification(
+            500, 100, (32, 32, 3), n_classes=10, noise=0.5, seed=32323)
+        np.testing.assert_array_equal(ty, sy)
+        np.testing.assert_allclose(
+            tx, np.round(sx * 255.0).astype(np.uint8) / 255.0,
+            atol=1e-7)
+
+    def test_generator_idempotent(self, tmp_path):
+        base = datasets.generate_cifar10_batches(str(tmp_path),
+                                                 n_train=50, n_test=10)
+        mtimes = {f: os.path.getmtime(os.path.join(base, f))
+                  for f in os.listdir(base)}
+        base2 = datasets.generate_cifar10_batches(str(tmp_path),
+                                                  n_train=99,
+                                                  n_test=10)
+        assert base2 == base
+        for f, t in mtimes.items():
+            assert os.path.getmtime(os.path.join(base, f)) == t
+
+    def test_partial_genuine_set_never_overwritten(self, tmp_path):
+        genuine = np.zeros((3, 3073), np.uint8) + 7
+        genuine.tofile(str(tmp_path / "data_batch_1.bin"))
+        with pytest.raises(FileExistsError, match="partial"):
+            datasets.generate_cifar10_batches(str(tmp_path),
+                                              n_train=50, n_test=10)
+        back = np.fromfile(str(tmp_path / "data_batch_1.bin"),
+                           np.uint8)
+        np.testing.assert_array_equal(back, genuine.reshape(-1))
+        assert not os.path.exists(tmp_path / "test_batch.bin")
+
+    def test_corrupt_batch_rejected_not_crashed(self, tmp_path):
+        """A truncated .bin batch must make the real-file probe return
+        None (fall back to synthetic), not raise."""
+        d = tmp_path / "cifar10" / "cifar-10-batches-bin"
+        d.mkdir(parents=True)
+        for name in ("data_batch_1 data_batch_2 data_batch_3 "
+                     "data_batch_4 data_batch_5 test_batch").split():
+            (d / f"{name}.bin").write_bytes(b"\x01" * 100)  # not 3073k
+        old = root.common.get("data_dir") if "common" in root else None
+        root.common.data_dir = str(tmp_path)
+        try:
+            assert datasets.try_load_real_cifar10() is None
+        finally:
+            root.common.data_dir = old
+
+
+class TestPickleLayout:
+    def test_python_pickle_batches_load(self, tmp_path):
+        """The upstream python-version layout (pickle dicts with
+        b'data' / b'labels') parses identically to binary."""
+        d = tmp_path / "cifar10" / "cifar-10-batches-py"
+        d.mkdir(parents=True)
+        rng = np.random.default_rng(5)
+        want_x, want_y = [], []
+        names = [f"data_batch_{i}" for i in range(1, 6)] + \
+            ["test_batch"]
+        for name in names:
+            x = rng.integers(0, 256, (20, 3072)).astype(np.uint8)
+            y = rng.integers(0, 10, 20).astype(np.int64)
+            with open(d / name, "wb") as f:
+                # py2-era upstream pickles have bytes keys
+                pickle.dump({b"data": x, b"labels": list(y)}, f)
+            want_x.append(x)
+            want_y.append(y)
+        old = root.common.get("data_dir") if "common" in root else None
+        root.common.data_dir = str(tmp_path)
+        try:
+            real = datasets.try_load_real_cifar10()
+        finally:
+            root.common.data_dir = old
+        assert real is not None
+        (tx, ty), (vx, vy) = real
+        assert tx.shape == (100, 32, 32, 3) and vx.shape[0] == 20
+        np.testing.assert_array_equal(
+            ty, np.concatenate(want_y[:-1]).astype(np.int32))
+        # channel deinterleave: plane layout R|G|B -> HWC
+        np.testing.assert_allclose(
+            tx[0], want_x[0][0].reshape(3, 32, 32)
+            .transpose(1, 2, 0) / 255.0, atol=1e-7)
+
+
+class TestRealFileTraining:
+    def test_loader_prefers_real_files(self, cifar_dir):
+        from veles_tpu.loader.synthetic import Cifar10Loader
+        from veles_tpu.workflow import Workflow
+        w = Workflow(name="t")
+        ld = Cifar10Loader(w, name="loader", minibatch_size=50)
+        ld.initialize(device=None)
+        assert ld.class_lengths == [0, 100, 500]
+
+    def test_baseline_config_trains_from_real_files(self, cifar_dir):
+        """BASELINE config #3 (CIFAR-10 conv + LR policy + weight
+        decay) end-to-end from real-format batch files."""
+        prng.seed_all(4321)
+        from veles_tpu.models import cifar10
+
+        class FL:
+            workflow = None
+        w = cifar10.create_workflow(
+            FL(), loader={"minibatch_size": 50},
+            decision={"max_epochs": 2})
+        w.initialize(device=JaxDevice(platform="cpu"))
+        assert w.loader.class_lengths == [0, 100, 500]
+        w.run()
+        hist = [h for h in w.decision.history
+                if h["class"] == "validation"]
+        assert len(hist) == 2
+        assert all(np.isfinite(h["loss"]) for h in w.decision.history)

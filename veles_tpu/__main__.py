@@ -143,6 +143,23 @@ def _ga_worker_count(args) -> int:
     return 1
 
 
+def _resolve_ga_execution(backend: str, workers: int):
+    """(workers, worker_backend) such that parallel genome workers can
+    never race to initialize an exclusive TPU chip:
+
+    - ``auto`` + parallel workers -> workers evaluate on ``cpu`` (the
+      chip, if any, stays unclaimed; host cores do the fan-out);
+    - explicit ``tpu``/``jax`` + parallel workers -> serialized to 1
+      worker (honors the device choice; the chip admits one client);
+    - ``cpu``/``numpy`` parallelize freely.
+    """
+    if workers <= 1 or backend in ("numpy", "cpu"):
+        return workers, backend
+    if backend == "auto":
+        return workers, "cpu"
+    return 1, backend
+
+
 def run_optimizer(args, workflow_file: str, config_files, overrides) \
         -> int:
     """GA mode (reference: veles --optimize): genes are Tune(...)
@@ -170,11 +187,21 @@ def run_optimizer(args, workflow_file: str, config_files, overrides) \
         return 2
     pop_s, _, gen_s = args.optimize.partition(":")
     pop, gen = int(pop_s), int(gen_s or 3)
-    workers = _ga_worker_count(args)
+    workers, worker_backend = _resolve_ga_execution(
+        args.backend, _ga_worker_count(args))
+    if worker_backend != args.backend:
+        print(f"--optimize: {workers} parallel workers with -b auto "
+              f"evaluate on cpu so they cannot race for an exclusive "
+              f"TPU chip (pass -b tpu to serialize on the chip "
+              f"instead)", file=sys.stderr)
+    elif workers == 1 and args.ga_workers > 1:
+        print(f"--optimize: -b {args.backend} admits one client — "
+              f"--ga-workers {args.ga_workers} serialized to 1",
+              file=sys.stderr)
 
     base_cmd = [sys.executable, "-m", "veles_tpu.genetics.worker",
                 workflow_file, *config_files, *overrides,
-                "-b", args.backend, "-s", str(args.seed)]
+                "-b", worker_backend, "-s", str(args.seed)]
 
     def evaluate_one(values) -> float:
         cmd = base_cmd + ["--values", json.dumps(values)]

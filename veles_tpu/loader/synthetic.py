@@ -60,25 +60,62 @@ class SyntheticClassificationLoader(FullBatchLoader):
                                       "original_targets")
 
 
-class MnistLoader(SyntheticClassificationLoader):
-    """Real MNIST IDX files if pre-placed under the data dir, else the
-    synthetic 28x28x1 stand-in (this image has no datasets and no
-    network — SURVEY.md §0)."""
+class DeviceSyntheticLoader(SyntheticClassificationLoader):
+    """The synthetic set born directly in HBM (datasets.
+    synthetic_classification_device): zero host datagen and zero
+    host->device upload.  The TPU-first answer to 'building the
+    ImageNet-scale benchmark set costs minutes of single-core numpy +
+    a slow tunnel upload' — the benchmark's dataset is procedural, so
+    the accelerator generates it where it will be consumed.
 
-    def __init__(self, workflow=None, n_train: int = 60000,
-                 n_valid: int = 10000, **kwargs: Any) -> None:
-        super().__init__(workflow, n_train=n_train, n_valid=n_valid,
-                         shape=(28, 28, 1), seed=28281, **kwargs)
+    Falls back to the host generator whenever the device path cannot
+    serve: numpy backend, a sharded mesh device (the devmem layout
+    would need mesh-aware placement), a set that exceeds the HBM
+    residency budget (streaming needs host arrays by design), or a
+    normalization request (the fit reads the host array).
+    """
 
     def load_data(self) -> None:
-        real = datasets.try_load_real_mnist()
+        dev = self.device
+        a = self.gen_args
+        n_total = a["n_train"] + a["n_valid"] + a["n_test"]
+        est_bytes = int(np.prod(a["shape"])) * 4 * n_total
+        if dev is None or not getattr(dev, "is_jax", False) \
+                or getattr(dev, "mesh", None) is not None \
+                or est_bytes > self._resident_budget() \
+                or self.normalization_type != "none" \
+                or self.normalizer is not None:
+            super().load_data()
+            return
+        data, labels = datasets.synthetic_classification_device(
+            n_total, a["shape"], n_classes=a["n_classes"],
+            noise=a["noise"], max_shift=a["max_shift"], seed=a["seed"],
+            jax_device=dev.jax_device)
+        # [test | valid | train] layout; one device stream serves all
+        # three splits (split membership is positional, like the host
+        # generator's concatenation)
+        self.class_lengths[TEST] = a["n_test"]
+        self.class_lengths[VALID] = a["n_valid"]
+        self.class_lengths[TRAIN] = a["n_train"]
+        self.original_data.devmem = data
+        self.original_labels.devmem = labels
+        if self.targets_from_data:
+            self.original_targets.devmem = data
+
+
+class _RealFileMixin:
+    """Shared 'real files if pre-placed, else synthetic' load_data for
+    loaders over a (train, test) split pair returned by a
+    ``try_load_real_*`` function."""
+
+    def _load_real_or_synthetic(self, real) -> None:
         if real is None:
             super().load_data()
             return
         (tx, ty), (vx, vy) = real
         # n_train / n_valid act as caps on the real files too — a
         # config asking for a 100-sample smoke run must not silently
-        # train on all 60k rows just because IDX files exist on disk
+        # train on all the rows just because real files exist on disk
         n_tr = min(self.gen_args["n_train"], len(tx))
         n_va = min(self.gen_args["n_valid"], len(vx))
         tx, ty = tx[:n_tr], ty[:n_tr]
@@ -91,3 +128,33 @@ class MnistLoader(SyntheticClassificationLoader):
             [vy, ty], axis=0).astype(np.int32)
         if self.targets_from_data:
             self.original_targets.mem = self.original_data.mem
+
+
+class MnistLoader(_RealFileMixin, SyntheticClassificationLoader):
+    """Real MNIST IDX files if pre-placed under the data dir, else the
+    synthetic 28x28x1 stand-in (this image has no datasets and no
+    network — SURVEY.md §0)."""
+
+    def __init__(self, workflow=None, n_train: int = 60000,
+                 n_valid: int = 10000, **kwargs: Any) -> None:
+        super().__init__(workflow, n_train=n_train, n_valid=n_valid,
+                         shape=(28, 28, 1), seed=28281, **kwargs)
+
+    def load_data(self) -> None:
+        self._load_real_or_synthetic(datasets.try_load_real_mnist())
+
+
+class Cifar10Loader(_RealFileMixin, SyntheticClassificationLoader):
+    """Real CIFAR-10 batch files (binary or python-pickle layout) if
+    pre-placed under the data dir, else the synthetic 32x32x3
+    stand-in."""
+
+    def __init__(self, workflow=None, n_train: int = 50000,
+                 n_valid: int = 10000, **kwargs: Any) -> None:
+        kwargs.setdefault("noise", 0.5)
+        kwargs.setdefault("seed", 32323)
+        super().__init__(workflow, n_train=n_train, n_valid=n_valid,
+                         shape=(32, 32, 3), **kwargs)
+
+    def load_data(self) -> None:
+        self._load_real_or_synthetic(datasets.try_load_real_cifar10())

@@ -7,7 +7,7 @@ stack with ReLU, inverse-decay learning-rate schedule, L2 weight decay.
 
 from __future__ import annotations
 
-from veles_tpu.loader.synthetic import SyntheticClassificationLoader
+from veles_tpu.loader.synthetic import Cifar10Loader
 from veles_tpu.models import model_config
 from veles_tpu.ops.standard_workflow import StandardWorkflow
 
@@ -16,8 +16,7 @@ GD = {"learning_rate": 0.02, "weight_decay": 0.0005,
 
 DEFAULTS = {
     "loader": {"minibatch_size": 100, "n_train": 50000,
-               "n_valid": 10000, "shape": (32, 32, 3),
-               "noise": 0.5, "seed": 32323},
+               "n_valid": 10000},
     "layers": [
         {"type": "conv_relu",
          "->": {"n_kernels": 32, "kx": 5, "ky": 5, "padding": 2},
@@ -49,7 +48,7 @@ def create_workflow(launcher, **overrides):
     cfg = model_config("cifar10", DEFAULTS).todict()
     cfg.update(overrides)
     w = StandardWorkflow(
-        loader_factory=lambda wf: SyntheticClassificationLoader(
+        loader_factory=lambda wf: Cifar10Loader(
             wf, name="loader", **cfg["loader"]),
         layers=cfg["layers"],
         loss_function="softmax",
