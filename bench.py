@@ -275,14 +275,11 @@ def streaming_metric(mb, n_train, device, firings, repeats):
 
 
 def main() -> None:
-    global _TiledSyntheticLoader
     # the streaming phase re-derives its base set from the same args —
     # opt into the dataset memo (datasets._synth_cache)
     os.environ.setdefault("VELES_TPU_SYNTH_CACHE", "1")
     from veles_tpu import profiling
     from veles_tpu.backends import make_device
-
-    _TiledSyntheticLoader = _tiled_loader_class()
 
     # defaults = the measured-best configuration (docs/perf.md sweep):
     # mb=512 amortizes optimizer/weight traffic, superstep 8 amortizes
@@ -307,6 +304,10 @@ def main() -> None:
               n_classes=1000)
     device = make_device("auto")
     w.initialize(device=device)
+    # attribution line for the driver log: everything before this is
+    # device datagen + host param fill + param upload; everything after
+    # up to the first rate is trace + XLA compile + the timed firings
+    phase("initialized (datagen + param init/upload done)")
     if not device.is_jax:
         raise SystemExit("bench needs a jax device (TPU or XLA:CPU)")
 

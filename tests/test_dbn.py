@@ -1,0 +1,93 @@
+"""DBN: greedy RBM pretraining feeding the fine-tuned MLP
+(models/mnist_dbn.py — the consumer of RBM.hidden_of's stacking
+surface; SURVEY.md §3.2 "RBM / other")."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import JaxDevice
+from veles_tpu.models import mnist_dbn
+
+LOADER = {"minibatch_size": 25, "n_train": 400, "n_valid": 100}
+HIDDEN = [32, 16]
+
+
+@pytest.fixture(scope="module")
+def dev():
+    return JaxDevice(platform="cpu")
+
+
+class FakeLauncher:
+    workflow = None
+
+
+def _finetune_val_errors(pretrained, epochs, dev):
+    prng.seed_all(99)
+    fl = FakeLauncher()
+    w = mnist_dbn.create_workflow(
+        fl, loader=dict(LOADER), hidden=list(HIDDEN),
+        decision={"max_epochs": epochs})
+    w.initialize(device=dev)
+    if pretrained is not None:
+        mnist_dbn.apply_pretrained(w, pretrained)
+    w.run()
+    errs = [h["error_pct"] for h in w.decision.history
+            if h["class"] == "validation"]
+    w.stop()
+    return errs
+
+
+@pytest.fixture(scope="module")
+def pretrained(dev):
+    prng.seed_all(7)
+    return mnist_dbn.pretrain(device=dev, loader_cfg=dict(LOADER),
+                              hidden=HIDDEN, epochs=3)
+
+
+class TestDbn:
+    def test_pretrain_shapes(self, pretrained):
+        assert len(pretrained) == 2
+        assert pretrained[0]["weights"].shape == (28 * 28, 32)
+        assert pretrained[0]["bias"].shape == (32,)
+        # stage 2 stacks on stage 1's hidden width
+        assert pretrained[1]["weights"].shape == (32, 16)
+        assert pretrained[1]["bias"].shape == (16,)
+        for p in pretrained:
+            assert np.isfinite(p["weights"]).all()
+            assert p["weights"].std() > 0  # actually trained
+
+    def test_pretraining_beats_cold_start(self, pretrained, dev):
+        """The DBN's reason to exist: at a fixed small fine-tune
+        budget and fixed seed, RBM-initialized layers reach lower
+        validation error than cold-start backprop."""
+        cold = _finetune_val_errors(None, epochs=2, dev=dev)
+        warm = _finetune_val_errors(pretrained, epochs=2, dev=dev)
+        assert warm[-1] < cold[-1], (warm, cold)
+
+    def test_transplant_rejects_mismatched_stack(self, pretrained, dev):
+        prng.seed_all(5)
+        fl = FakeLauncher()
+        w = mnist_dbn.create_workflow(
+            fl, loader=dict(LOADER), hidden=[32],  # one layer only
+            decision={"max_epochs": 1})
+        w.initialize(device=dev)
+        with pytest.raises(ValueError):
+            mnist_dbn.apply_pretrained(w, pretrained)
+        w.stop()
+
+    def test_transplanted_weights_are_live(self, pretrained, dev):
+        """The transplanted parameters must be what the first fused
+        firing actually consumes (not clobbered by fill_params)."""
+        prng.seed_all(11)
+        fl = FakeLauncher()
+        w = mnist_dbn.create_workflow(
+            fl, loader=dict(LOADER), hidden=list(HIDDEN),
+            decision={"max_epochs": 1})
+        w.initialize(device=dev)
+        mnist_dbn.apply_pretrained(w, pretrained)
+        from veles_tpu.ops.all2all import All2AllSigmoid
+        sig = [f for f in w.forwards if isinstance(f, All2AllSigmoid)]
+        got = np.asarray(sig[0].gather_params()["weights"])
+        np.testing.assert_array_equal(got, pretrained[0]["weights"])
+        w.stop()

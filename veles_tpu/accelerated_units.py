@@ -63,7 +63,9 @@ class AcceleratedUnit(Unit):
                 v.initialize(self.device)
         for v in self.output_vectors.values():
             if v:
-                v.initialize(self.device)
+                # outputs are written (devmem rebind / host overwrite)
+                # before anything reads them — never pre-upload
+                v.initialize(self.device, upload=False)
 
     # -- the pure compute seam ----------------------------------------
 

@@ -37,6 +37,11 @@ class Device(Logger):
     def get(self, buf: Any) -> np.ndarray:
         return np.asarray(buf)
 
+    def zeros(self, shape, dtype=np.float32) -> Any:
+        """A zero buffer in this device's memory (host numpy here; jax
+        devices generate it on-device — no host array, no transfer)."""
+        return np.zeros(shape, dtype)
+
     def compile(self, fn: Callable, **jit_kwargs: Any) -> Callable:
         return fn
 
@@ -117,6 +122,11 @@ class JaxDevice(Device):
 
     def get(self, buf: Any) -> np.ndarray:
         return np.asarray(buf)
+
+    def zeros(self, shape, dtype=np.float32) -> Any:
+        import jax.numpy as jnp
+        with self._jax.default_device(self.jax_device):
+            return jnp.zeros(shape, dtype)
 
     def compile(self, fn: Callable, **jit_kwargs: Any) -> Callable:
         return self._jax.jit(fn, **jit_kwargs)

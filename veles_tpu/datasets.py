@@ -140,6 +140,10 @@ def _read_cifar10_bin(path: str) -> Split:
                          f"({raw.size} bytes)")
     rec = raw.reshape(-1, 3073)
     y = rec[:, 0].astype(np.int32)
+    if y.max(initial=0) > 9:
+        # a right-sized garbage/foreign file must trigger the synthetic
+        # fallback, not feed labels up to 255 into a 10-class workflow
+        raise ValueError(f"{path}: labels outside 0..9 — not CIFAR-10")
     x = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
     return np.ascontiguousarray(x, np.float32) / np.float32(255.0), y
 
@@ -159,6 +163,8 @@ def _read_cifar10_py(path: str) -> Split:
         raise ValueError(f"{path}: not a CIFAR-10 pickle batch")
     x = data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
     y = np.asarray(labels, np.int32)
+    if y.size and (y.min() < 0 or y.max() > 9):
+        raise ValueError(f"{path}: labels outside 0..9 — not CIFAR-10")
     return np.ascontiguousarray(x, np.float32) / np.float32(255.0), y
 
 
@@ -520,7 +526,8 @@ def synthetic_classification_device(n: int, shape: Tuple[int, ...],
                                     noise: float = 0.4,
                                     max_shift: int = 2,
                                     seed: int = 20260729,
-                                    jax_device=None):
+                                    jax_device=None,
+                                    sharding=None):
     """The synthetic classification task born ON the accelerator: same
     family as ``synthetic_classification`` (low-frequency class
     templates -> per-sample circular shift -> gaussian noise ->
@@ -563,6 +570,16 @@ def synthetic_classification_device(n: int, shape: Tuple[int, ...],
             x = x[..., 0]
         return x, y
 
+    if sharding is not None:
+        # mesh case: generate straight into the requested layout
+        # (replicated for the resident-dataset step) — every device
+        # runs the same cheap gen computation, nothing crosses the
+        # host or the interconnect
+        data, labels = jax.jit(
+            gen, out_shardings=(sharding, sharding))(
+            jax.random.PRNGKey(seed))
+        data.block_until_ready()
+        return data, labels
     import contextlib
     ctx = jax.default_device(jax_device) if jax_device is not None \
         else contextlib.nullcontext()
@@ -618,13 +635,23 @@ def _main(argv=None) -> int:
     return 0
 
 
+def cap_real(real, n_train: int, n_valid: int):
+    """Requested sizes act as caps on real files too: a 100-sample
+    smoke run must not silently get the full 50k/10k set just because
+    files exist.  THE single policy point — both the module-level
+    dataset functions and loader._RealFileMixin go through here."""
+    (tx, ty), (vx, vy) = real
+    return (tx[:n_train], ty[:n_train]), (vx[:n_valid], vy[:n_valid]), \
+        None
+
+
 def mnist(n_train: int = 60000, n_valid: int = 10000,
           force_synthetic: bool = False):
     """MNIST: real IDX files if present, else synthetic 28x28x1."""
     if not force_synthetic:
         real = try_load_real_mnist()
         if real is not None:
-            return real[0], real[1], None
+            return cap_real(real, n_train, n_valid)
     return synthetic_classification(
         n_train, n_valid, (28, 28, 1), n_classes=10, seed=28281)
 
@@ -635,7 +662,7 @@ def cifar10(n_train: int = 50000, n_valid: int = 10000,
     if not force_synthetic:
         real = try_load_real_cifar10()
         if real is not None:
-            return real[0], real[1], None
+            return cap_real(real, n_train, n_valid)
     return synthetic_classification(
         n_train, n_valid, (32, 32, 3), n_classes=10, noise=0.5, seed=32323)
 

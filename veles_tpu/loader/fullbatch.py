@@ -128,22 +128,27 @@ class FullBatchLoader(Loader):
             self.minibatch_targets = Vector(
                 np.zeros(tshape, self.original_targets.dtype),
                 name="minibatch_targets")
+        # staging buffers: fill_minibatch overwrites them before any
+        # read, and the fused device path never touches them — the
+        # eager upload of their zeros (mb x sample = 100s of MB at
+        # AlexNet scale) bought nothing
         for v in (self.minibatch_data, self.minibatch_labels):
             if v:
-                v.initialize(self.device)
+                v.initialize(self.device, upload=False)
 
     def fill_minibatch(self) -> None:
+        # map_read, not .mem: a device-born dataset (DeviceSynthetic
+        # Loader, incl. on a mesh) has no host copy until fetched —
+        # the eager wiring must still be able to fill host minibatches
         idx = self.minibatch_indices.map_read()
-        data = self.original_data.mem
-        if data is None:
-            data = self.original_data.map_read()
-        self.minibatch_data.map_invalidate()[:] = data[idx]
+        self.minibatch_data.map_invalidate()[:] = \
+            self.original_data.map_read()[idx]
         if self.has_labels:
             self.minibatch_labels.map_invalidate()[:] = \
-                self.original_labels.mem[idx]
+                self.original_labels.map_read()[idx]
         if self.has_targets:
             self.minibatch_targets.map_invalidate()[:] = \
-                self.original_targets.mem[idx]
+                self.original_targets.map_read()[idx]
 
     def assemble_rows(self, indices: np.ndarray):
         """Streaming-mode assembly: slice the host arrays (already

@@ -68,11 +68,15 @@ class DeviceSyntheticLoader(SyntheticClassificationLoader):
     a slow tunnel upload' — the benchmark's dataset is procedural, so
     the accelerator generates it where it will be consumed.
 
+    On a mesh device the set is generated REPLICATED under a
+    ``NamedSharding`` — every device runs the same cheap gen program,
+    so the future multi-chip benchmark pays zero host datagen and zero
+    per-device upload exactly where those hurt most.
+
     Falls back to the host generator whenever the device path cannot
-    serve: numpy backend, a sharded mesh device (the devmem layout
-    would need mesh-aware placement), a set that exceeds the HBM
-    residency budget (streaming needs host arrays by design), or a
-    normalization request (the fit reads the host array).
+    serve: numpy backend, a set that exceeds the HBM residency budget
+    (streaming needs host arrays by design), or a normalization
+    request (the fit reads the host array).
     """
 
     def load_data(self) -> None:
@@ -81,16 +85,21 @@ class DeviceSyntheticLoader(SyntheticClassificationLoader):
         n_total = a["n_train"] + a["n_valid"] + a["n_test"]
         est_bytes = int(np.prod(a["shape"])) * 4 * n_total
         if dev is None or not getattr(dev, "is_jax", False) \
-                or getattr(dev, "mesh", None) is not None \
                 or est_bytes > self._resident_budget() \
                 or self.normalization_type != "none" \
                 or self.normalizer is not None:
             super().load_data()
             return
+        mesh = getattr(dev, "mesh", None)
+        sharding = None
+        if mesh is not None:
+            from veles_tpu.parallel.mesh import replicated_sharding
+            sharding = replicated_sharding(mesh)
         data, labels = datasets.synthetic_classification_device(
             n_total, a["shape"], n_classes=a["n_classes"],
             noise=a["noise"], max_shift=a["max_shift"], seed=a["seed"],
-            jax_device=dev.jax_device)
+            jax_device=None if sharding is not None else dev.jax_device,
+            sharding=sharding)
         # [test | valid | train] layout; one device stream serves all
         # three splits (split membership is positional, like the host
         # generator's concatenation)
@@ -112,14 +121,12 @@ class _RealFileMixin:
         if real is None:
             super().load_data()
             return
-        (tx, ty), (vx, vy) = real
         # n_train / n_valid act as caps on the real files too — a
         # config asking for a 100-sample smoke run must not silently
         # train on all the rows just because real files exist on disk
-        n_tr = min(self.gen_args["n_train"], len(tx))
-        n_va = min(self.gen_args["n_valid"], len(vx))
-        tx, ty = tx[:n_tr], ty[:n_tr]
-        vx, vy = vx[:n_va], vy[:n_va]
+        # (datasets.cap_real is the single policy point)
+        (tx, ty), (vx, vy), _ = datasets.cap_real(
+            real, self.gen_args["n_train"], self.gen_args["n_valid"])
         self.class_lengths[TEST] = 0
         self.class_lengths[VALID] = len(vx)
         self.class_lengths[TRAIN] = len(tx)

@@ -101,10 +101,20 @@ class Vector:
 
     # -- device attach -------------------------------------------------
 
-    def initialize(self, device) -> None:
-        """Attach to a device; pushes host data to HBM on jax devices."""
+    def initialize(self, device, upload: bool = True) -> None:
+        """Attach to a device; pushes host data to HBM on jax devices.
+
+        ``upload=False`` attaches WITHOUT the eager host->device push —
+        for scratch buffers (unit outputs, err_inputs, host minibatch
+        staging) that every consumer either rebinds (``devmem = step
+        output``) or overwrites before reading.  Correctness is
+        unchanged (``unmap()`` still uploads on demand); what it avoids
+        is streaming gigabytes of just-allocated zeros through a thin
+        tunnel at initialize time, which measured as the bulk of the
+        benchmark's 239s build dead time (round-4 VERDICT next #4)."""
         self.device = device
-        if device is not None and device.is_jax and self._mem is not None:
+        if upload and device is not None and device.is_jax \
+                and self._mem is not None:
             self.unmap()
 
     @property

@@ -1,0 +1,190 @@
+"""MNIST DBN: greedy layer-wise RBM pretraining + backprop fine-tune.
+
+Reference parity: the upstream RBM family (veles/znicz/rbm_units.py,
+SURVEY.md §3.2 "RBM / other" — reconstructed from the survey
+description; the reference mount is empty, SURVEY.md §0) exists to
+PRETRAIN deep belief networks: each RBM learns a layer of
+representation, its weights/hidden-bias seed the matching dense layer
+of a feed-forward net, and the whole stack is then fine-tuned with
+ordinary backprop.  This module is that consumer — the stacking surface
+``RBM.hidden_of()`` exposes finally gets used.
+
+Pipeline (``run()`` / the pieces individually):
+
+1. ``pretrain()`` — for each hidden width, train a Bernoulli RBM by
+   CD-1 (the first on deterministically-binarized pixels, later ones on
+   the previous RBM's mean-field hidden probabilities, computed with
+   ``RBM.hidden_of``), harvesting ``(weights, hidden bias)``.
+2. ``create_workflow()`` — the fine-tune net: binarization ->
+   All2AllSigmoid per hidden width -> softmax, trained with
+   cross-entropy.  A sigmoid dense layer computes exactly
+   ``hidden_of``: sigmoid(x W + b), so transplanted RBM weights
+   reproduce the pretrained representation at initialization.
+3. ``apply_pretrained()`` — the transplant, after ``initialize``.
+
+TPU notes: every stage is a StandardWorkflow, so pretraining and
+fine-tuning both run as fused jitted supersteps on a jax device and as
+the classic unit graph on numpy; the hidden representations for
+stage k+1 are computed host-side once per stage (a dataset-sized
+matmul, not a hot path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.loader.fullbatch import ArrayLoader
+from veles_tpu.loader.synthetic import MnistLoader
+from veles_tpu.models import model_config
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+DEFAULTS = {
+    "loader": {"minibatch_size": 100, "n_train": 60000,
+               "n_valid": 10000},
+    "hidden": [196, 64],
+    "pretrain": {"epochs": 3, "learning_rate": 0.1,
+                 "gradient_moment": 0.5},
+    "decision": {"max_epochs": 10, "fail_iterations": 50},
+    "snapshotter": None,
+}
+
+
+def _sigmoid(v: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def pretrain(device=None, loader_cfg: Optional[Dict[str, Any]] = None,
+             hidden=(196, 64), epochs: int = 3,
+             learning_rate: float = 0.1,
+             gradient_moment: float = 0.5) -> List[Dict[str, np.ndarray]]:
+    """Greedy layer-wise CD-1 pretraining.
+
+    Returns one ``{"weights": (n_in, n_hid), "bias": (n_hid,)}`` per
+    entry of ``hidden`` — ready for :func:`apply_pretrained`.
+    """
+    loader_cfg = dict(DEFAULTS["loader"], **(loader_cfg or {}))
+    results: List[Dict[str, np.ndarray]] = []
+
+    # stage 1: binarized pixels -> RBM, on the real MNIST loader
+    w1 = StandardWorkflow(
+        loader_factory=lambda wf: MnistLoader(
+            wf, name="loader", targets_from_data=True, **loader_cfg),
+        layers=[
+            {"type": "binarization", "->": {}, "<-": {}},
+            {"type": "rbm", "->": {"n_hidden": int(hidden[0])},
+             "<-": {"learning_rate": learning_rate,
+                    "gradient_moment": gradient_moment}},
+        ],
+        loss_function="mse",
+        decision_config={"max_epochs": epochs},
+        name="DbnPretrain1")
+    w1.initialize(device=device)
+    w1.run()
+    rbm1 = w1.forwards[1]
+    results.append({
+        "weights": np.array(rbm1.weights.map_read()),
+        "bias": np.array(rbm1.bias.map_read())})
+
+    # the representation the NEXT stage trains on: deterministic
+    # binarization (eval-mode threshold), then h = hidden_of(...)
+    ld = w1.loader
+    data = np.asarray(ld.original_data.map_read(), np.float32)
+    x = (data > 0.5).astype(np.float32).reshape(len(data), -1)
+    off_v, off_t = ld.class_offset(VALID), ld.class_offset(TRAIN)
+    n_v, n_t = ld.class_lengths[VALID], ld.class_lengths[TRAIN]
+    w1.stop()
+
+    for depth, n_hid in enumerate(hidden[1:], start=2):
+        prev = results[-1]
+        h = _sigmoid(x @ prev["weights"] + prev["bias"]) \
+            .astype(np.float32)
+        wk = StandardWorkflow(
+            loader_factory=lambda wf: ArrayLoader(
+                wf, name="loader",
+                train=(h[off_t:off_t + n_t],),
+                valid=(h[off_v:off_v + n_v],) if n_v else None,
+                targets_from_labels=True,
+                minibatch_size=loader_cfg["minibatch_size"]),
+            layers=[{"type": "rbm", "->": {"n_hidden": int(n_hid)},
+                     "<-": {"learning_rate": learning_rate,
+                            "gradient_moment": gradient_moment}}],
+            loss_function="mse",
+            decision_config={"max_epochs": epochs},
+            name=f"DbnPretrain{depth}")
+        wk.initialize(device=device)
+        wk.run()
+        rbm = wk.forwards[0]
+        results.append({
+            "weights": np.array(rbm.weights.map_read()),
+            "bias": np.array(rbm.bias.map_read())})
+        wk.stop()
+        x = h  # stage k+2 stacks on this stage's representation
+
+    return results
+
+
+def create_workflow(launcher, **overrides):
+    """The fine-tune MLP (binarization -> sigmoid stack -> softmax).
+
+    Cold-start unless :func:`apply_pretrained` transplants RBM weights
+    after ``initialize``."""
+    cfg = model_config("mnist_dbn", DEFAULTS).todict()
+    cfg.update(overrides)
+    layers = [{"type": "binarization", "->": {}, "<-": {}}]
+    for n_hid in cfg["hidden"]:
+        layers.append({"type": "all2all_sigmoid",
+                       "->": {"output_sample_shape": int(n_hid)},
+                       "<-": {"learning_rate": 0.1,
+                              "gradient_moment": 0.9}})
+    layers.append({"type": "softmax",
+                   "->": {"output_sample_shape": 10},
+                   "<-": {"learning_rate": 0.1,
+                          "gradient_moment": 0.9}})
+    w = StandardWorkflow(
+        loader_factory=lambda wf: MnistLoader(
+            wf, name="loader", **cfg["loader"]),
+        layers=layers,
+        loss_function="softmax",
+        decision_config=cfg["decision"],
+        snapshotter_config=cfg.get("snapshotter"),
+        name="MnistDbnWorkflow")
+    launcher.workflow = w
+    return w
+
+
+def apply_pretrained(workflow,
+                     pretrained: List[Dict[str, np.ndarray]]) -> None:
+    """Transplant pretrained RBM (weights, hidden bias) pairs into the
+    workflow's sigmoid stack.  Call after ``initialize`` (fill_params
+    must have allocated the Vectors) and before ``run``."""
+    from veles_tpu.ops.all2all import All2AllSigmoid
+    sigmoids = [f for f in workflow.forwards
+                if isinstance(f, All2AllSigmoid)]
+    if len(sigmoids) != len(pretrained):
+        raise ValueError(
+            f"{len(pretrained)} pretrained layers for "
+            f"{len(sigmoids)} sigmoid layers in the stack")
+    for f, p in zip(sigmoids, pretrained):
+        if tuple(f.weights.shape) != tuple(p["weights"].shape):
+            raise ValueError(
+                f"{f.name}: weights {tuple(f.weights.shape)} vs "
+                f"pretrained {tuple(p['weights'].shape)}")
+        f.weights.map_invalidate()[:] = p["weights"]
+        f.bias.map_invalidate()[:] = p["bias"]
+
+
+def run(launcher):
+    cfg = model_config("mnist_dbn", DEFAULTS).todict()
+    launcher.create_workflow(create_workflow)
+    launcher.initialize()
+    pre_cfg = cfg["pretrain"]
+    pretrained = pretrain(
+        device=launcher.device, loader_cfg=cfg["loader"],
+        hidden=cfg["hidden"], epochs=pre_cfg["epochs"],
+        learning_rate=pre_cfg["learning_rate"],
+        gradient_moment=pre_cfg["gradient_moment"])
+    apply_pretrained(launcher.workflow, pretrained)
+    launcher.run()

@@ -116,9 +116,14 @@ class ForwardUnit(AcceleratedUnit):
         out_shape = self.output_shape_for(in_shape)
         if not self.output or tuple(self.output.shape) != out_shape:
             self.output.mem = np.zeros(out_shape, np.float32)
-        vecs = [self.input, self.output]
-        vecs.extend(self.param_vectors().values())
-        for v in vecs:
+        # input/output are scratch on jax devices: input is written by
+        # the producer (loader fill / previous unit's devmem rebind)
+        # and output by this unit's own firing, always before a read —
+        # eagerly uploading their just-allocated zeros costs gigabytes
+        # of tunnel traffic + HBM at AlexNet scale and serves nothing
+        self.input.initialize(device, upload=False)
+        self.output.initialize(device, upload=False)
+        for v in self.param_vectors().values():
             if v:
                 v.initialize(device)
 
@@ -222,15 +227,22 @@ class GradientUnit(AcceleratedUnit):
         f = self.forward
         if f is not None and not self.err_input:
             # raises AttributeError until the forward is initialized ->
-            # Workflow.initialize retries us later
+            # Workflow.initialize retries us later.  Scratch: every
+            # consumer rebinds/overwrites before reading (upload=False).
             self.err_input.mem = np.zeros(f.input.shape, np.float32)
-            self.err_input.initialize(device)
+            self.err_input.initialize(device, upload=False)
         if self.gradient_moment and f is not None:
             for pname, vec in f.param_vectors().items():
                 if vec and pname not in self.accumulated_grads:
-                    acc = Vector(np.zeros(vec.shape, np.float32),
-                                 name=f"{self.name}.vel_{pname}")
+                    acc = Vector(name=f"{self.name}.vel_{pname}")
                     acc.initialize(device)
+                    if device is not None and device.is_jax:
+                        # zeros are born on the device (XLA generates
+                        # them) — uploading host zeros the size of the
+                        # params wastes tunnel bandwidth and wall clock
+                        acc.devmem = device.zeros(vec.shape, np.float32)
+                    else:
+                        acc.mem = np.zeros(vec.shape, np.float32)
                     self.accumulated_grads[pname] = acc
 
     def reconcile_velocities(self) -> None:

@@ -35,6 +35,7 @@ class MeshJaxDevice(JaxDevice):
 
         self.mesh = mesh
         self._repl = replicated_sharding(mesh)
+        self._zeros_fn = None
         platform = mesh.devices.flat[0].platform
         super().__init__(platform=platform, compute_dtype=compute_dtype)
         self._jax = jax
@@ -42,6 +43,19 @@ class MeshJaxDevice(JaxDevice):
     def put(self, array) -> Any:
         import numpy as np
         return self._jax.device_put(np.array(array, copy=True), self._repl)
+
+    def zeros(self, shape, dtype=None) -> Any:
+        import numpy as np
+        if self._zeros_fn is None:
+            import jax.numpy as jnp
+            # one jitted fn with static (shape, dtype): momentum
+            # allocation calls this once per parameter and a fresh
+            # lambda per call would defeat jit's cache (recompile each)
+            self._zeros_fn = self._jax.jit(
+                lambda shape, dtype: jnp.zeros(shape, dtype),
+                static_argnums=(0, 1), out_shardings=self._repl)
+        dtype = np.dtype(dtype if dtype is not None else np.float32)
+        return self._zeros_fn(tuple(int(s) for s in shape), dtype)
 
     def __repr__(self) -> str:
         n = self.mesh.devices.size
