@@ -1,0 +1,151 @@
+"""Per-layer analytic roofline for the AlexNet fused step (round-4
+VERDICT next #3: pin the MFU ceiling or find the next lever).
+
+For every forward layer this prints analytic training FLOPs, a
+minimum-HBM-traffic estimate, the implied MXU-time and HBM-time floors
+(v5e: 197 TFLOP/s bf16, 819 GB/s), which of the two binds, and the
+layer's floor share of the whole step.  The sum of per-layer floors is
+the step's analytic lower bound; analytic-train-FLOPs over that bound
+is the model's MFU CEILING on this chip — what a perfect scheduler
+could reach, independent of XLA.
+
+Traffic model (bf16 activations, f32 master params + momentum),
+per sample, assuming perfect elementwise fusion (optimistic — real
+XLA materializes more, so the printed ceiling is an upper bound):
+
+- weighted layers (conv/dense): fwd reads in + weights, writes out;
+  bwd reads err_out + residual(in) + weights (dgrad) + residual(in)
+  again (wgrad), writes err_in; optimizer traffic is
+  16 B/param / minibatch (f32 read+write of weights and velocity).
+- LRN: fwd reads in, writes out + den residual; bwd reads err_out +
+  in + den, writes err_in.
+- pooling: fwd read in / write out; bwd read err_out + in, write
+  err_in (select-and-scatter needs the argmax source).
+- activation/dropout: fused into their producers — zero extra traffic
+  (dropout's bf16 mask residual counted: one write + one read).
+
+Usage: python scripts/layer_roofline.py [mb]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+PEAK_FLOPS = 197e12     # v5e bf16
+HBM_BPS = 819e9         # v5e HBM bandwidth
+ACT = 2                 # bf16 activation bytes
+P32 = 4                 # f32 param bytes
+
+
+def build_forwards(mb: int):
+    from veles_tpu import prng
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.loader.synthetic import SyntheticClassificationLoader
+    from veles_tpu.models.alexnet import alexnet_layers
+    from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+    prng.seed_all(1234)
+    w = StandardWorkflow(
+        loader_factory=lambda wf: SyntheticClassificationLoader(
+            wf, name="loader", minibatch_size=mb, n_train=mb,
+            n_valid=0, shape=(227, 227, 3), n_classes=1000,
+            seed=227227),
+        layers=alexnet_layers(1000),
+        loss_function="softmax",
+        decision_config={"max_epochs": 1},
+        name="RooflineShapes")
+    w.initialize(device=NumpyDevice())   # shape resolution only
+    return w.forwards
+
+
+def layer_rows(forwards, mb: int):
+    from veles_tpu import profiling
+
+    rows = []
+    for i, u in enumerate(forwards):
+        kind = type(u).__name__
+        fwd_flops = profiling.forward_flops_per_sample(u)
+        weighted = profiling.unit_has_weights(u)
+        train_flops = fwd_flops * (3.0 if weighted else 2.0)
+        in_b = int(np.prod(u.input.shape[1:])) * ACT
+        out_b = int(np.prod(u.output.shape[1:])) * ACT
+        params = (int(np.prod(u.weights.shape)) if weighted else 0) + \
+            (int(np.prod(u.bias.shape))
+             if weighted and u.bias else 0)
+        w_b = params * ACT              # bf16 cast the step computes in
+        first = i == 0                  # chain head skips err_input
+        if weighted:
+            # fwd: in + weights(bf16) + out; bwd: err_out + in (dgrad
+            # src) + weights + in again (wgrad) + err_in write.  ALL
+            # weight traffic amortizes over the minibatch: one batched
+            # matmul reads the weights once for mb samples.  Optimizer
+            # traffic is f32 read+write of weights and velocity
+            # (16 B/param), also once per minibatch.
+            wpm = w_b / mb
+            bytes_s = (in_b + wpm + out_b
+                       + out_b + in_b + wpm + in_b
+                       + (0 if first else in_b)
+                       + 16.0 * params / mb)
+        elif "LRN" in kind:
+            bytes_s = (in_b + out_b + out_b * 2            # fwd + den
+                       + out_b + in_b + out_b * 2 + in_b)  # bwd
+        elif "Pooling" in kind:
+            bytes_s = in_b + out_b + out_b + in_b + in_b
+        elif "Dropout" in kind:
+            bytes_s = out_b * 2                            # mask w+r
+        else:                                              # activation
+            bytes_s = 0.0
+        # MXU time only for matmul-family work; VPU elementwise is
+        # bandwidth-modelled, not FLOPs-modelled
+        mxu_flops = train_flops if weighted else 0.0
+        if "LRN" in kind:   # banded matmul rides the MXU
+            mxu_flops = train_flops
+        t_mxu = mxu_flops / PEAK_FLOPS
+        t_hbm = bytes_s / HBM_BPS
+        rows.append({
+            "name": u.name, "kind": kind,
+            "out": tuple(int(s) for s in u.output.shape[1:]),
+            "params": params,
+            "train_gflops": train_flops / 1e9,
+            "mb_bytes": bytes_s / 2 ** 20,
+            "t_mxu_us": t_mxu * 1e6,
+            "t_hbm_us": t_hbm * 1e6,
+            "bound": "mxu" if t_mxu >= t_hbm else "hbm",
+            "floor_us": max(t_mxu, t_hbm) * 1e6,
+        })
+    return rows
+
+
+def main():
+    mb = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    forwards = build_forwards(mb)
+    rows = layer_rows(forwards, mb)
+    total_floor = sum(r["floor_us"] for r in rows)
+    total_flops = sum(r["train_gflops"] for r in rows)
+    print(f"# per-sample, mb={mb}; floors vs v5e peaks "
+          f"(197 TF bf16, 819 GB/s)")
+    hdr = (f"{'layer':<22}{'out':<16}{'tGFLOP':>8}{'MB':>7}"
+           f"{'t_mxu':>8}{'t_hbm':>8}{'bound':>6}{'floor':>8}"
+           f"{'share':>7}")
+    print(hdr)
+    for r in rows:
+        print(f"{r['name']:<22}{str(r['out']):<16}"
+              f"{r['train_gflops']:>8.3f}{r['mb_bytes']:>7.2f}"
+              f"{r['t_mxu_us']:>8.2f}{r['t_hbm_us']:>8.2f}"
+              f"{r['bound']:>6}{r['floor_us']:>8.2f}"
+              f"{100 * r['floor_us'] / total_floor:>6.1f}%")
+    ceiling = total_flops * 1e9 / PEAK_FLOPS / (total_floor * 1e-6)
+    print(f"\ntotal: {total_flops:.3f} train GFLOP/sample, "
+          f"floor {total_floor:.1f} us/sample "
+          f"-> analytic MFU ceiling {100 * ceiling:.1f}%")
+    print(f"measured (BENCH_r05): 14072 img/s = 71.06 us/sample "
+          f"-> 48.7% MFU; gap to floor = "
+          f"{71.06 / (total_floor):.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,5 +8,9 @@ keeping N live workflows.
 """
 
 from veles_tpu.ensemble.core import EnsemblePredictor, EnsembleTrainer
+from veles_tpu.ensemble.packaging import (load_members,
+                                          load_packed_ensemble,
+                                          pack_ensemble, save_members)
 
-__all__ = ["EnsembleTrainer", "EnsemblePredictor"]
+__all__ = ["EnsembleTrainer", "EnsemblePredictor", "save_members",
+           "load_members", "pack_ensemble", "load_packed_ensemble"]

@@ -13,34 +13,68 @@ from veles_tpu.logger import Logger
 class EnsembleTrainer(Logger):
     """Trains N members of ``workflow_factory() -> StandardWorkflow``
     with per-member seeds; records each member's trained params and
-    validation error."""
+    validation error.
 
-    def __init__(self, workflow_factory: Callable[[], Any],
+    With ``member_values`` (a list of hyperparameter dicts — e.g. the
+    GA's top-K genomes via :meth:`from_ga`), the factory is called as
+    ``workflow_factory(values)`` per member, so members differ by
+    HYPERPARAMETERS on top of seeds.  Values must not change the
+    architecture (layer shapes): the averaged predictor swaps member
+    params through one shared forward chain."""
+
+    def __init__(self, workflow_factory: Callable[..., Any],
                  device_factory: Callable[[], Any],
                  n_members: int = 4,
-                 base_seed: int = 1234) -> None:
+                 base_seed: int = 1234,
+                 member_values: Optional[List[Dict[str, Any]]] = None
+                 ) -> None:
         self.workflow_factory = workflow_factory
         self.device_factory = device_factory
-        self.n_members = n_members
+        self.member_values = member_values
+        self.n_members = n_members if member_values is None \
+            else len(member_values)
         self.base_seed = base_seed
-        #: [{"params": pytree, "valid_error": float, "seed": int}]
+        #: [{"params": pytree, "valid_error": float, "seed": int,
+        #:   "values": hyperparams-or-None}]
         self.members: List[Dict[str, Any]] = []
+
+    @classmethod
+    def from_ga(cls, optimizer, workflow_factory: Callable[..., Any],
+                device_factory: Callable[[], Any], k: int = 4,
+                base_seed: int = 1234) -> "EnsembleTrainer":
+        """Seed the ensemble from a finished GA's final-generation
+        top-K genomes (reference coupling: upstream builds ensembles
+        from the tuner's best individuals — SURVEY.md §3.1 Ensemble).
+        ``optimizer`` is a ``genetics.GeneticOptimizer`` whose
+        ``run()`` has completed; ``workflow_factory(values)`` builds a
+        member with that genome's hyperparameters."""
+        if not getattr(optimizer, "history", None):
+            raise ValueError(
+                "run the GA first — optimizer.history is empty")
+        top = optimizer.history[-1][:max(1, k)]   # best-first
+        return cls(workflow_factory, device_factory,
+                   base_seed=base_seed,
+                   member_values=[dict(v) for _, v in top])
 
     def train(self) -> List[Dict[str, Any]]:
         for i in range(self.n_members):
             seed = self.base_seed + 7919 * i
             prng.seed_all(seed)
-            w = self.workflow_factory()
+            values = self.member_values[i] \
+                if self.member_values is not None else None
+            w = self.workflow_factory(values) if values is not None \
+                else self.workflow_factory()
             w.initialize(device=self.device_factory())
             w.run()
             params = self._trained_params(w)
             err = w.decision.epoch_error_pct[1]
             self.members.append({"params": params, "valid_error": err,
-                                 "seed": seed,
+                                 "seed": seed, "values": values,
                                  "forward_names": [f.name
                                                    for f in w.forwards]})
-            self.info("member %d/%d (seed %d): valid error %.2f%%",
-                      i + 1, self.n_members, seed, err)
+            self.info("member %d/%d (seed %d%s): valid error %.2f%%",
+                      i + 1, self.n_members, seed,
+                      f", {values}" if values else "", err)
         return self.members
 
     @staticmethod

@@ -1,0 +1,106 @@
+"""Ensemble <-> Forge coupling: persist trained members and ship them
+as a Forge package.
+
+Reference parity: upstream couples its ensembles to the model
+marketplace — a trained ensemble is a publishable artifact, not a
+process-lifetime object (SURVEY.md §3.1 Ensemble / Forge rows; the
+reference mount is empty, so the coupling shape is reconstructed from
+the survey).  Here the trained member parameters are serialized to one
+compressed ``.npz`` (arrays + a JSON metadata record) that rides a
+standard Forge package as its ``snapshot`` member, so the whole
+existing pipeline — pack, publish, store listing, fetch, checksum
+verify, install — works on ensembles unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from veles_tpu.forge import ForgePackage
+
+_SEP = "|"
+_META = "__meta__"
+
+
+def save_members(path: str, members: List[Dict[str, Any]]) -> str:
+    """Serialize ``EnsembleTrainer.members`` to one compressed npz:
+    ``m<i>|<forward>|<param>`` arrays plus a JSON metadata record
+    (seed, valid_error, forward_names, GA values)."""
+    if not members:
+        raise ValueError("empty ensemble")
+    arrays: Dict[str, np.ndarray] = {}
+    meta = []
+    for i, m in enumerate(members):
+        meta.append({"seed": m["seed"],
+                     "valid_error": m["valid_error"],
+                     "forward_names": m["forward_names"],
+                     "values": m.get("values")})
+        for fname, p in m["params"].items():
+            if _SEP in fname:
+                raise ValueError(f"forward name {fname!r} contains "
+                                 f"{_SEP!r}")
+            for pname, arr in p.items():
+                arrays[f"m{i}{_SEP}{fname}{_SEP}{pname}"] = \
+                    np.asarray(arr)
+    arrays[_META] = np.frombuffer(
+        json.dumps(meta).encode(), np.uint8).copy()
+    np.savez_compressed(path, **arrays)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def load_members(path: str) -> List[Dict[str, Any]]:
+    """Inverse of :func:`save_members` — returns member dicts directly
+    consumable by ``EnsemblePredictor``."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z[_META]))
+        members: List[Dict[str, Any]] = []
+        for i, md in enumerate(meta):
+            # weightless forwards (pooling/LRN/dropout) serialize no
+            # arrays but the predictor indexes params[f.name] for
+            # EVERY forward — seed each name with an empty dict
+            params: Dict[str, Dict[str, np.ndarray]] = {
+                fn: {} for fn in md.get("forward_names", [])}
+            prefix = f"m{i}{_SEP}"
+            for key in z.files:
+                if key.startswith(prefix):
+                    _, fname, pname = key.split(_SEP)
+                    params.setdefault(fname, {})[pname] = z[key]
+            members.append(dict(md, params=params))
+    return members
+
+
+def pack_ensemble(out_path: str, name: str,
+                  members: List[Dict[str, Any]],
+                  workflow_file: str,
+                  config_files: Optional[List[str]] = None,
+                  version: str = "1.0.0", author: str = "",
+                  description: str = "") -> str:
+    """Package a trained ensemble for Forge: the members npz becomes
+    the package snapshot, ``workflow_file`` the runnable entry."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        npz = os.path.join(tmp, f"{name}_members.npz")
+        save_members(npz, members)
+        return ForgePackage.pack(
+            out_path, name, workflow_file,
+            config_files=config_files, snapshot=npz,
+            version=version, author=author,
+            description=description or
+            f"ensemble of {len(members)} members")
+
+
+def load_packed_ensemble(pkg_path: str, dest_dir: str,
+                         verify: bool = True) -> List[Dict[str, Any]]:
+    """Install a fetched ensemble package (checksum-verified) and load
+    its members."""
+    manifest = ForgePackage.install(pkg_path, dest_dir, verify=verify)
+    snap = manifest.get("snapshot")
+    if not snap:
+        raise ValueError(f"{pkg_path}: package has no ensemble "
+                         f"snapshot member")
+    return load_members(os.path.join(manifest["root"], snap))

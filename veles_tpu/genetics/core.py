@@ -276,8 +276,14 @@ class GeneticOptimizer(Logger):
                 self._fitness_many(new[self.elite:])])
             pop, fits = new, new_fits
             self._save_state(gen + 1, pop, fits)
+        # the last bred population WAS evaluated — record it, or
+        # history[-1] silently under-reports the final state (e.g.
+        # EnsembleTrainer.from_ga would seed from the previous
+        # generation's ranking even when final offspring beat it)
         order = np.argsort(fits)
-        best = self._decode(pop[order[0]])
-        self.info("GA done: best fitness %.4f with %s",
-                  fits[order[0]], best)
-        return best, float(fits[order[0]])
+        pop, fits = pop[order], fits[order]
+        self.history.append([(float(f), self._decode(g))
+                             for f, g in zip(fits, pop)])
+        best = self._decode(pop[0])
+        self.info("GA done: best fitness %.4f with %s", fits[0], best)
+        return best, float(fits[0])

@@ -86,6 +86,12 @@ class FusedStepRunner(AcceleratedUnit):
         #: batches; the third dispatch blocks on the oldest transfer
         from collections import deque
         self._inflight: Any = deque()
+        #: cumulative seconds this runner spent submitting streaming
+        #: uploads and blocked on their drain — the transfer-busy
+        #: numerator of the input pipeline's efficiency accounting
+        #: (bench.py): on a link-bound host a perfect pipeline spends
+        #: ~all its wall here, and the remainder is framework overhead
+        self.stream_transfer_seconds = 0.0
 
     _unpicklable = AcceleratedUnit._unpicklable + (
         "_train_step", "_eval_step", "_params", "_opt", "mesh",
@@ -471,6 +477,7 @@ class FusedStepRunner(AcceleratedUnit):
         dispatch loop) back-pressures the loop instead of piling
         unsent host batches into RAM without bound."""
         import jax
+        import time
         xb = ld.superstep_data
         tb = ld.superstep_targets if self._has_targets() \
             else ld.superstep_labels
@@ -481,6 +488,7 @@ class FusedStepRunner(AcceleratedUnit):
                 f"{'targets' if self._has_targets() else 'labels'})")
         dst = self._batch_sharding if self.mesh is not None \
             else self.device.jax_device
+        t_transfer = time.perf_counter()
         xb = jax.device_put(xb, dst)
         tb = jax.device_put(tb, dst)
         if self.mesh is not None:
@@ -489,6 +497,7 @@ class FusedStepRunner(AcceleratedUnit):
         if len(self._inflight) > 2:
             for buf in self._inflight.popleft():
                 buf.block_until_ready()
+        self.stream_transfer_seconds += time.perf_counter() - t_transfer
         if train:
             self._params, self._opt, self._acc, self._conf = \
                 self._train_step(
@@ -635,6 +644,7 @@ class FusedStepRunner(AcceleratedUnit):
         self.__dict__.pop("lr_scales", None)  # pre-rename snapshots
         self.__dict__.setdefault("lr_rates", None)
         self.__dict__.setdefault("streaming", False)
+        self.__dict__.setdefault("stream_transfer_seconds", 0.0)
         from collections import deque
         if self.__dict__.get("_inflight") is None:  # dropped by pickle
             self._inflight = deque()
