@@ -35,6 +35,7 @@ enum OpType {
   OP_DECONV = 7,
   OP_ACTIVATION = 8,
   OP_STOCHPOOL_EVAL = 9,
+  OP_BINARIZE = 10,  // inference form of rbm.Binarization: x > 0.5
 };
 
 enum Act {
@@ -171,6 +172,7 @@ bool infer_shapes(VelesModel *m, std::string *why) {
       case OP_LRN:
       case OP_DROPOUT:
       case OP_ACTIVATION:
+      case OP_BINARIZE:
         break;  // shape preserved
       default:
         *why = "unknown op type";
@@ -529,6 +531,11 @@ extern "C" int veles_run(const VelesModel *m, const float *input,
         std::memcpy(y, x, batch * next.numel() * sizeof(float));
         apply_act(op.act, y, batch, next.numel());
         break;
+      case OP_BINARIZE: {
+        int64_t n = batch * next.numel();
+        for (int64_t j = 0; j < n; ++j) y[j] = x[j] > 0.5f ? 1.0f : 0.0f;
+        break;
+      }
       default:
         return -2;
     }

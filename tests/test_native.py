@@ -163,3 +163,21 @@ class TestNativeRuntime:
         with pytest.raises(ValueError, match="sample shape"):
             m.run(np.zeros((2, 5, 5, 1), np.float32))
         m.close()
+
+
+class TestDbnExport:
+    def test_dbn_mlp_roundtrip(self, tmp_path):
+        """The fine-tuned DBN stack (binarization -> sigmoid dense ->
+        softmax) must deploy through the native runtime — OP_BINARIZE
+        carries the eval-mode threshold (models/mnist_dbn.py)."""
+        w = build_and_train([
+            {"type": "binarization", "->": {}, "<-": {}},
+            {"type": "all2all_sigmoid",
+             "->": {"output_sample_shape": 12},
+             "<-": {"learning_rate": 0.1}},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.1}},
+        ])
+        want, got = roundtrip(w, tmp_path)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        np.testing.assert_allclose(got.sum(-1), 1.0, atol=1e-5)

@@ -17,7 +17,8 @@ import numpy as np
 
 # op / activation / attr enums — must match native/src/libveles.cc
 OP_DENSE, OP_CONV, OP_MAXPOOL, OP_AVGPOOL, OP_LRN, OP_DROPOUT, \
-    OP_DECONV, OP_ACTIVATION, OP_STOCHPOOL_EVAL = range(1, 10)
+    OP_DECONV, OP_ACTIVATION, OP_STOCHPOOL_EVAL, OP_BINARIZE \
+    = range(1, 11)
 ACT = {"linear": 0, "tanh": 1, "relu": 2, "sigmoid": 3, "softmax": 4,
        "log": 5}
 A_KX, A_KY, A_SX, A_SY, A_PX, A_PY, A_NKERN, A_LRN_N, A_ALPHA, \
@@ -38,6 +39,7 @@ def _op_record(unit) -> Tuple[int, int, Dict[int, float],
     from veles_tpu.ops.lrn import LRNormalizer
     from veles_tpu.ops.pooling import (AvgPooling, MaxPooling,
                                        StochasticPooling)
+    from veles_tpu.ops.rbm import Binarization
 
     act = ACT.get(unit.activation_mode, 0)
     tensors: Dict[int, np.ndarray] = {}
@@ -77,6 +79,9 @@ def _op_record(unit) -> Tuple[int, int, Dict[int, float],
                            A_BETA: unit.beta, A_K: unit.k}, {}
     if isinstance(unit, Dropout):
         return OP_DROPOUT, 0, {}, {}
+    if isinstance(unit, Binarization):
+        # inference semantics = the unit's eval mode: x > 0.5
+        return OP_BINARIZE, 0, {}, {}
     if isinstance(unit, ActivationBase):
         return OP_ACTIVATION, act, {}, {}
     raise ValueError(

@@ -52,10 +52,6 @@ DEFAULTS = {
 }
 
 
-def _sigmoid(v: np.ndarray) -> np.ndarray:
-    return 1.0 / (1.0 + np.exp(-v))
-
-
 def pretrain(device=None, loader_cfg: Optional[Dict[str, Any]] = None,
              hidden=(196, 64), epochs: int = 3,
              learning_rate: float = 0.1,
@@ -83,10 +79,10 @@ def pretrain(device=None, loader_cfg: Optional[Dict[str, Any]] = None,
         name="DbnPretrain1")
     w1.initialize(device=device)
     w1.run()
-    rbm1 = w1.forwards[1]
+    rbm_unit = w1.forwards[1]
     results.append({
-        "weights": np.array(rbm1.weights.map_read()),
-        "bias": np.array(rbm1.bias.map_read())})
+        "weights": np.array(rbm_unit.weights.map_read()),
+        "bias": np.array(rbm_unit.bias.map_read())})
 
     # the representation the NEXT stage trains on: deterministic
     # binarization (eval-mode threshold), then h = hidden_of(...)
@@ -98,9 +94,12 @@ def pretrain(device=None, loader_cfg: Optional[Dict[str, Any]] = None,
     w1.stop()
 
     for depth, n_hid in enumerate(hidden[1:], start=2):
+        # the representation stage k+1 trains on is literally what the
+        # trained RBM computes — RBM.hidden_of, not a transcription
         prev = results[-1]
-        h = _sigmoid(x @ prev["weights"] + prev["bias"]) \
-            .astype(np.float32)
+        h = np.asarray(rbm_unit.hidden_of(
+            {"weights": prev["weights"], "bias": prev["bias"]}, x),
+            np.float32)
         wk = StandardWorkflow(
             loader_factory=lambda wf: ArrayLoader(
                 wf, name="loader",
