@@ -28,11 +28,15 @@ single JSON print came after every phase):
 - The WHOLE streaming phase (build + compile + warmup + floors +
   windows) runs under one BENCH_STREAM_SECONDS deadline; the firing
   size is chosen from a raw link probe so measurement windows hold
-  several firings (a pipelined steady state), and every window is
-  bracketed by its own floor puts because the tunnel's bandwidth
-  drifts 2-3x on multi-second scales.  Its host-side dataset is
-  n_base distinct images tiled to full length — identical bytes moved
-  per step, a fraction of the single-core generation cost.
+  several firings (a pipelined steady state).  The primary efficiency
+  is the pipeline's transfer-busy fraction — intrinsic to the window,
+  because the tunnel's bandwidth is violently non-stationary (measured
+  33 MB/s..1.3 GB/s across adjacent windows) and any cross-window
+  floor ratio measures the link's mood; put-only reference windows
+  and raw per-sample times ship in the record as the cross-check.
+  The host-side dataset is n_base distinct images tiled to full
+  length — identical bytes moved per step, a fraction of the
+  single-core generation cost.
 
 Honesty contract (round-1 VERDICT weak #1/#2 fixes):
 
@@ -261,10 +265,8 @@ def run_tpu_tests():
             def __init__(self):
                 self._passed = set()
                 self._failed = set()
-                self.saw_reports = False
 
             def pytest_runtest_logreport(self, report):
-                self.saw_reports = True
                 if report.failed:
                     self._failed.add(report.nodeid)
                 elif report.when == "call" and report.passed:
@@ -291,8 +293,8 @@ def run_tpu_tests():
         print(f"tests_tpu: {counter.passed} passed, "
               f"{counter.failed} failed (pytest rc={rc})",
               file=sys.stderr)
-        if rc not in (0, 1) or (not counter._passed
-                                and not counter._failed):
+        if rc not in (0, 1) or (counter.passed == 0
+                                and counter.failed == 0):
             # collection/usage error, or nothing ran to completion
             # (e.g. the tier auto-skipped on a CPU-only run): a tier
             # that never RAN must not read as "ran clean"
@@ -382,9 +384,14 @@ def streaming_metric(device, phase):
         phase("streaming: compiled; paired put/pipeline windows")
         fire()                    # warmup: prime prefetch+double-buffer
         sync_images(fused)
-        win_firings = max(MIN_WINDOW_FIRINGS + 2,
-                          int(os.environ.get("BENCH_STREAM_WINDOW",
-                                             "6")))
+        win_req = int(os.environ.get("BENCH_STREAM_WINDOW", "6"))
+        win_firings = max(MIN_WINDOW_FIRINGS + 2, win_req)
+        if win_firings != win_req:
+            print(f"streaming: BENCH_STREAM_WINDOW={win_req} raised "
+                  f"to {win_firings} (2 queue-refill firings are "
+                  f"always discarded; windows must keep "
+                  f">= {MIN_WINDOW_FIRINGS} steady samples)",
+                  file=sys.stderr)
         #: per-sample durations, one list per round — the efficiency
         #: estimator is a ratio of MEDIANS pooled over the rounds that
         #: ran in the link's sustained regime (round 0 is discarded as
@@ -516,10 +523,23 @@ def streaming_metric(device, phase):
         # put/fire reference pools from the sustained-regime rounds
         # (round 0 burns the tunnel's idle burst credit)
         steady = slice(1, None) if len(rates) > 1 else slice(0, None)
-        put_pool = [t for r in put_rounds[steady] for t in r] \
-            or [t for r in put_rounds for t in r]
-        fire_pool = [t for r in fire_rounds[steady] for t in r] \
-            or [t for r in fire_rounds for t in r]
+        put_pool = [t for r in put_rounds[steady] for t in r]
+        fire_pool = [t for r in fire_rounds[steady] for t in r]
+        # round 0 rides the tunnel's banked burst credit: pools that
+        # come from it (deadline left no later round, or later rounds
+        # produced no steady samples) are FLAGGED, not silently
+        # published as a sustained-regime number
+        regime = "steady" if len(rates) > 1 else "burst_round0"
+        if not put_pool or not fire_pool:
+            # only round 0 produced samples — it rides the tunnel's
+            # banked burst credit, so FLAG the record rather than
+            # silently publishing it as a sustained-regime number
+            regime = "burst_round0"
+            print("streaming: steady-regime pools empty — publishing "
+                  "round-0 (burst-credit) samples, flagged via "
+                  "streaming_regime", file=sys.stderr)
+            put_pool = [t for r in put_rounds for t in r]
+            fire_pool = [t for r in fire_rounds for t in r]
         med_put = float(np.median(put_pool))
         med_fire = float(np.median(fire_pool))
         return {
@@ -533,6 +553,7 @@ def streaming_metric(device, phase):
             "streaming_minibatch_size": mb,
             "streaming_superstep": k,
             "streaming_window_firings": win_firings,
+            "streaming_regime": regime,
             "streaming_window_rates": [round(r, 2) for r in rates],
             "streaming_window_floors": [round(f, 2) for f in floors],
             "streaming_put_samples_sec": [round(t, 2)
@@ -617,6 +638,7 @@ def main() -> None:
         "streaming_minibatch_size": None,
         "streaming_superstep": None,
         "streaming_window_firings": None,
+        "streaming_regime": None,
         "streaming_window_rates": None,
         "streaming_window_floors": None,
         "streaming_put_samples_sec": None,
