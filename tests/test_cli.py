@@ -97,3 +97,45 @@ class TestCLI:
         r = run_cli([str(p)])
         assert r.returncode == 2
         assert "defines neither" in r.stderr
+
+
+class TestEnsembleCli:
+    def test_ensemble_train_then_test(self, tmp_path):
+        """--ensemble-train N persists members; --ensemble-test
+        aggregates them (reference CLI ensemble surface)."""
+        ens = str(tmp_path / "ens.npz")
+        r = run_cli(["veles_tpu/models/mnist.py", "-b", "cpu",
+                     "--ensemble-train", "2", "--ensemble-test",
+                     "--ensemble-file", ens,
+                     "root.mnist.loader.minibatch_size=25",
+                     "root.mnist.loader.n_train=200",
+                     "root.mnist.loader.n_valid=50",
+                     "root.mnist.decision.max_epochs=2"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["members"] == 2
+        assert len(out["member_valid_errors_pct"]) == 2
+        # aggregation must not be worse than the worst member
+        assert out["ensemble_valid_error_pct"] <= \
+            max(out["member_valid_errors_pct"]) + 1e-9
+        assert os.path.exists(ens)
+
+    def test_ensemble_needs_create_workflow(self, tmp_path):
+        p = tmp_path / "wf.py"
+        p.write_text("def run(launcher):\n    pass\n")
+        r = run_cli([str(p), "--ensemble-train", "2", "-b", "numpy"])
+        assert r.returncode == 2
+        assert "create_workflow" in r.stderr
+
+    def test_ensemble_edge_cases(self, tmp_path):
+        p = tmp_path / "wf.py"
+        p.write_text("def create_workflow(launcher):\n    pass\n")
+        # N < 1 rejected cleanly
+        r = run_cli([str(p), "--ensemble-train", "0", "-b", "numpy"])
+        assert r.returncode == 2 and "N >= 1" in r.stderr
+        # test-only with no member file: clean message, not traceback
+        r = run_cli([str(p), "--ensemble-test", "-b", "numpy",
+                     "--ensemble-file", str(tmp_path / "none.npz")])
+        assert r.returncode == 2
+        assert "does not exist" in r.stderr
+        assert "Traceback" not in r.stderr

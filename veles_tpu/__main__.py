@@ -62,6 +62,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ga-state", default=None, metavar="FILE",
                    help="per-generation GA checkpoint; an existing "
                         "file resumes the run")
+    p.add_argument("--ensemble-train", type=int, default=None,
+                   metavar="N",
+                   help="train N ensemble members of the workflow "
+                        "(per-member seeds; the workflow file must "
+                        "expose create_workflow) and save them to "
+                        "--ensemble-file")
+    p.add_argument("--ensemble-test", action="store_true",
+                   help="load --ensemble-file and report the "
+                        "aggregated (mean-probability) validation "
+                        "error")
+    p.add_argument("--ensemble-file", default="ensemble.npz",
+                   metavar="FILE",
+                   help="member store for --ensemble-train/test "
+                        "(default: ensemble.npz)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run plus "
                         "a per-layer FLOPs table into DIR")
@@ -116,6 +130,13 @@ def main(argv=None) -> int:
         return run_optimizer(args, workflow_file, config_files,
                              overrides)
 
+    if args.ensemble_train is not None or args.ensemble_test:
+        if args.ensemble_train is not None and args.ensemble_train < 1:
+            print(f"--ensemble-train needs N >= 1 "
+                  f"(got {args.ensemble_train})", file=sys.stderr)
+            return 2
+        return run_ensemble(args, workflow_file)
+
     launcher = Launcher(
         backend=args.backend, seed=args.seed, snapshot=args.snapshot,
         dp=args.dp, master_address=args.master_address,
@@ -158,6 +179,91 @@ def _resolve_ga_execution(backend: str, workers: int):
     if backend == "auto":
         return workers, "cpu"
     return 1, backend
+
+
+def run_ensemble(args, workflow_file: str) -> int:
+    """Ensemble mode (reference parity: the upstream CLI's ensemble
+    train/test surface — SURVEY.md §3.1 Ensemble row): ``--ensemble-
+    train N`` trains N members with per-member seeds and persists them
+    (veles_tpu/ensemble/packaging.py npz — the same container Forge
+    ensemble packages carry); ``--ensemble-test`` aggregates member
+    probabilities over the validation split."""
+    import json
+
+    from veles_tpu.backends import make_device
+    from veles_tpu.ensemble import (EnsemblePredictor, EnsembleTrainer,
+                                    load_members, save_members)
+    from veles_tpu.launcher import load_workflow_module
+    from veles_tpu.loader.base import VALID
+    from veles_tpu.logger import setup_logging
+
+    setup_logging(10 if args.verbose else 20)
+    mod = load_workflow_module(workflow_file)
+    create = getattr(mod, "create_workflow", None)
+    if create is None:
+        print(f"--ensemble-train/test need {workflow_file} to expose "
+              f"create_workflow(launcher)", file=sys.stderr)
+        return 2
+
+    class _FL:
+        workflow = None
+
+    def factory():
+        return create(_FL())
+
+    def device_factory():
+        return make_device(args.backend)
+
+    members = None
+    if args.ensemble_train is not None:
+        trainer = EnsembleTrainer(factory, device_factory,
+                                  n_members=args.ensemble_train,
+                                  base_seed=args.seed)
+        members = trainer.train()
+        # save_members returns the REAL path (npz suffix appended by
+        # numpy when missing) — report and reuse that, not the arg
+        path = save_members(args.ensemble_file, members)
+        print(json.dumps({
+            "members": len(members),
+            "member_valid_errors_pct": [round(m["valid_error"], 4)
+                                        for m in members],
+            "file": path}))
+        if not args.ensemble_test:
+            return 0
+
+    import numpy as np
+    if members is None:   # test-only invocation: load from disk
+        try:
+            members = load_members(args.ensemble_file)
+        except FileNotFoundError:
+            print(f"--ensemble-test: {args.ensemble_file!r} does not "
+                  f"exist (train one first with --ensemble-train N)",
+                  file=sys.stderr)
+            return 2
+    pred = EnsemblePredictor(factory, device_factory, members)
+    ld = pred.workflow.loader
+    n = ld.class_lengths[VALID]
+    if not n:
+        print("--ensemble-test: the workflow's loader has no "
+              "validation split", file=sys.stderr)
+        return 2
+    off = ld.class_offset(VALID)
+    x = np.asarray(ld.original_data.map_read()[off:off + n])
+    y = np.asarray(ld.original_labels.map_read()[off:off + n])
+    # evaluate in minibatch-sized chunks: one giant batch would
+    # materialize every member's full-split activations at once
+    chunk = max(1, ld.max_minibatch_size)
+    wrong = 0
+    for i in range(0, n, chunk):
+        wrong += int((pred.predict(x[i:i + chunk]) !=
+                      y[i:i + chunk]).sum())
+    err = 100.0 * wrong / n
+    print(json.dumps({
+        "members": len(members),
+        "ensemble_valid_error_pct": round(err, 4),
+        "member_valid_errors_pct": [round(m["valid_error"], 4)
+                                    for m in members]}))
+    return 0
 
 
 def run_optimizer(args, workflow_file: str, config_files, overrides) \
