@@ -262,3 +262,41 @@ class TestDeviceBornDataset:
         assert len(losses) == 3
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] < losses[0]  # it learns
+
+
+class TestSnapshotResumeOnChip:
+    def test_resume_matches_straight_run(self, tpu_device, tmp_path):
+        """Checkpoint/resume equivalence ON THE CHIP (SURVEY.md §5.4):
+        a bf16 fused run snapshotted mid-way and resumed must land on
+        the identical metric history as an uninterrupted run — pickles
+        round-trip HBM state (params, momentum, PRNG chains) through
+        host Vectors."""
+        from veles_tpu.snapshotter import load_workflow, save_workflow
+
+        def build(max_epochs):
+            # mlp_workflow seeds all streams itself (777)
+            return mlp_workflow(max_epochs=max_epochs)
+
+        w_ref = build(4)
+        w_ref.initialize(device=tpu_device)
+        w_ref.run()
+        ref_hist = [(h["class"], h["n_err"])
+                    for h in w_ref.decision.history]
+        w_ref.stop()
+
+        w1 = build(2)
+        w1.initialize(device=tpu_device)
+        w1.run()
+        path = str(tmp_path / "snap.pickle.gz")
+        save_workflow(w1, path)
+        w1.stop()
+
+        w2 = load_workflow(path)
+        w2.decision.max_epochs = 4
+        w2.decision.complete.set(False)
+        w2.initialize(device=tpu_device)
+        w2.run()
+        got_hist = [(h["class"], h["n_err"])
+                    for h in w2.decision.history]
+        w2.stop()
+        assert got_hist == ref_hist

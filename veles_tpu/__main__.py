@@ -233,10 +233,16 @@ def run_ensemble(args, workflow_file: str) -> int:
 
     import numpy as np
     if members is None:   # test-only invocation: load from disk
+        # numpy appends .npz on save — apply the SAME normalization
+        # here or a suffix-less --ensemble-file trains fine and then
+        # fails to load under the identical flag value
+        fname = args.ensemble_file \
+            if args.ensemble_file.endswith(".npz") \
+            else args.ensemble_file + ".npz"
         try:
-            members = load_members(args.ensemble_file)
+            members = load_members(fname)
         except FileNotFoundError:
-            print(f"--ensemble-test: {args.ensemble_file!r} does not "
+            print(f"--ensemble-test: {fname!r} does not "
                   f"exist (train one first with --ensemble-train N)",
                   file=sys.stderr)
             return 2
@@ -248,8 +254,14 @@ def run_ensemble(args, workflow_file: str) -> int:
               "validation split", file=sys.stderr)
         return 2
     off = ld.class_offset(VALID)
-    x = np.asarray(ld.original_data.map_read()[off:off + n])
-    y = np.asarray(ld.original_labels.map_read()[off:off + n])
+    try:
+        x = np.asarray(ld.original_data.map_read()[off:off + n])
+        y = np.asarray(ld.original_labels.map_read()[off:off + n])
+    except RuntimeError:
+        print("--ensemble-test needs a loader with host-resident "
+              "original_data/labels (full-batch); streaming loaders "
+              "are not supported here", file=sys.stderr)
+        return 2
     # evaluate in minibatch-sized chunks: one giant batch would
     # materialize every member's full-split activations at once
     chunk = max(1, ld.max_minibatch_size)
