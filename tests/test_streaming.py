@@ -302,3 +302,29 @@ class TestStreamDtypeAndRelease:
             # ... from the SYNCED values, not a re-init (SGD step is
             # small; re-init would differ by O(weight scale))
             assert np.abs(after[n] - before[n]).max() < 0.2
+
+
+class TestTransferAccounting:
+    def test_stream_transfer_seconds_accumulates_and_pickles(self):
+        """bench.py's primary streaming-efficiency metric depends on
+        FusedStepRunner.stream_transfer_seconds — it must accumulate
+        only in streaming mode and default to 0.0 across snapshots."""
+        ws = build_mlp(streaming=True)
+        ws.initialize(device=JaxDevice(platform="cpu"))
+        assert ws.fused.stream_transfer_seconds == 0.0
+        ws.run()
+        assert ws.fused.stream_transfer_seconds > 0.0
+
+        wr = build_mlp()
+        wr.initialize(device=JaxDevice(platform="cpu"))
+        wr.run()
+        assert wr.fused.stream_transfer_seconds == 0.0  # resident path
+
+        # snapshot round-trip: the counter is plain state; pre-field
+        # snapshots default it (fused.__setstate__)
+        import pickle
+        state = pickle.loads(pickle.dumps(ws.fused.__getstate__()))
+        state.pop("stream_transfer_seconds", None)
+        ws.fused.__dict__.pop("stream_transfer_seconds", None)
+        ws.fused.__setstate__(state)
+        assert ws.fused.stream_transfer_seconds == 0.0

@@ -300,3 +300,36 @@ class TestSnapshotResumeOnChip:
                     for h in w2.decision.history]
         w2.stop()
         assert got_hist == ref_hist
+
+
+class TestStreamingAccountingOnChip:
+    def test_streaming_trains_and_accounts_transfers(self, tpu_device):
+        """The streaming path on the real chip (the benchmark's
+        streaming phase in miniature): residency budget forces
+        host-assembled superstep batches, training proceeds, and the
+        transfer accounting bench.py's efficiency metric reads is
+        live."""
+        prng.seed_all(2026)
+        w = StandardWorkflow(
+            loader_factory=lambda wf: SyntheticClassificationLoader(
+                wf, name="loader", minibatch_size=20, n_train=160,
+                n_valid=40, shape=(10, 10, 1), n_classes=4, seed=11,
+                max_resident_bytes=0),  # force streaming
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 24},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}},
+                {"type": "softmax", "->": {"output_sample_shape": 4},
+                 "<-": {"learning_rate": 0.05}}],
+            decision_config={"max_epochs": 3},
+            superstep=2, name="TpuStreaming")
+        w.initialize(device=tpu_device)
+        assert w.fused.streaming
+        assert not w.loader.device_resident
+        w.run()
+        losses = history(w)
+        assert len(losses) == 3
+        assert losses[-1] < losses[0]
+        assert w.fused.stream_transfer_seconds > 0.0
+        w.stop()
