@@ -86,7 +86,7 @@ MIN_WINDOW_FIRINGS = 3
 
 
 def build(mb, n_train, image, n_classes, streaming=False,
-          superstep=None):
+          superstep=None, quantized=False):
     from veles_tpu import prng
     from veles_tpu.loader.synthetic import DeviceSyntheticLoader
     from veles_tpu.models.alexnet import alexnet_layers
@@ -94,10 +94,21 @@ def build(mb, n_train, image, n_classes, streaming=False,
 
     prng.seed_all(1234)
     if streaming:
-        loader_factory = lambda wf: _tiled_loader_class()(  # noqa: E731
-            wf, name="loader", minibatch_size=mb, n_train=n_train,
-            n_valid=0, shape=image, n_classes=n_classes, seed=227227,
-            max_resident_bytes=0)
+        def loader_factory(wf, _q=quantized):
+            cls = _tiled_loader_class()
+            kw = {}
+            if _q:
+                # uint8 wire: bytes are re-encoded pixels, the linear
+                # normalizer maps them back to ~[0, 1] on device
+                kw = {"normalization_type": "linear",
+                      "normalization_parameters": {"lo": 0.0,
+                                                   "hi": 1.0}}
+            ld = cls(wf, name="loader", minibatch_size=mb,
+                     n_train=n_train, n_valid=0, shape=image,
+                     n_classes=n_classes, seed=227227,
+                     max_resident_bytes=0, **kw)
+            ld.quantized = _q
+            return ld
     else:
         # resident: the dataset is generated in HBM by the device
         loader_factory = lambda wf: DeviceSyntheticLoader(  # noqa: E731
@@ -131,6 +142,10 @@ def _tiled_loader_class():
 
     class TiledSyntheticLoader(SyntheticClassificationLoader):
         N_BASE = 512
+        #: True = store the tiled pixels as uint8 (the quantized-wire
+        #: streaming phase): 1 byte/pixel on the link, dequantized by
+        #: the fused step's on-device prologue
+        quantized = False
 
         def load_data(self) -> None:
             a = self.gen_args
@@ -142,6 +157,9 @@ def _tiled_loader_class():
             n = a["n_train"]
             reps = -(-n // n_base)
             self.class_lengths[:] = [0, 0, n]
+            if self.quantized:
+                bx = np.round(np.clip(np.asarray(bx), 0.0, 1.0)
+                              * 255.0).astype(np.uint8)
             self.original_data.mem = np.tile(
                 bx, (reps,) + (1,) * (bx.ndim - 1))[:n]
             self.original_labels.mem = np.tile(by, reps)[:n].astype(
@@ -335,6 +353,7 @@ def streaming_metric(device, phase):
     """
     if os.environ.get("BENCH_SKIP_STREAMING"):
         return None
+    quantized = bool(os.environ.get("BENCH_STREAM_QUANTIZED"))
     deadline = time.perf_counter() + STREAM_SECONDS
     try:
         import jax
@@ -345,24 +364,45 @@ def streaming_metric(device, phase):
         t0 = time.perf_counter()
         jax.device_put(probe, device.jax_device).block_until_ready()
         link_mbps = 8.0 / max(time.perf_counter() - t0, 1e-4)
+        # 1-byte probe: same byte count as uint8 elements — what the
+        # quantized wire would see.  Ships in the record as the
+        # 1-byte/pixel roofline next to the measured 2-byte floor.
+        probe_u8 = np.zeros(8 << 20, np.uint8)  # 8 MB
+        jax.device_put(probe_u8, device.jax_device).block_until_ready()
+        t0 = time.perf_counter()
+        jax.device_put(probe_u8, device.jax_device).block_until_ready()
+        link_mbps_u8 = 8.0 / max(time.perf_counter() - t0, 1e-4)
+        img_px = 227 * 227 * 3
+        # projected floor at 1 byte/pixel from the uint8 probe
+        floor_1byte = link_mbps_u8 / (img_px / 2 ** 20)
         # firing = k minibatches of mb images; pick k so the firing's
-        # link time ~= TARGET_FIRING_SEC (2 bytes/px: bf16 streaming)
-        img_mb = (227 * 227 * 3 * 2) / 2 ** 20
-        k = int(round(TARGET_FIRING_SEC * link_mbps / (img_mb * mb)))
+        # link time ~= TARGET_FIRING_SEC (wire: 1 byte/px quantized
+        # uint8, else 2 bytes/px bf16)
+        img_mb = (img_px * (1 if quantized else 2)) / 2 ** 20
+        probe_rate = link_mbps_u8 if quantized else link_mbps
+        k = int(round(TARGET_FIRING_SEC * probe_rate / (img_mb * mb)))
         k = max(1, min(16, k))
-        phase(f"streaming: link ~{link_mbps:.0f} MB/s -> superstep "
-              f"{k} (firing = {k * mb} images)")
+        phase(f"streaming: link ~{link_mbps:.0f} MB/s "
+              f"(uint8 ~{link_mbps_u8:.0f}) -> superstep "
+              f"{k} (firing = {k * mb} images"
+              f"{', quantized wire' if quantized else ''})")
         w = build(mb=mb, n_train=2 * k * mb, image=(227, 227, 3),
-                  n_classes=1000, streaming=True, superstep=k)
+                  n_classes=1000, streaming=True, superstep=k,
+                  quantized=quantized)
         w.initialize(device=device)
         if not w.fused.streaming:
             raise RuntimeError(
                 "residency budget did not force streaming")
+        if quantized and w.loader.dequant is None:
+            raise RuntimeError(
+                "BENCH_STREAM_QUANTIZED set but the loader did not "
+                "derive a dequantization affine")
         # first firing: assembles a superstep batch + compiles the
         # streaming trace (the phase deadline covers it)
         w.loader.run()
         batch = w.loader.superstep_data
         n_img = batch.shape[0] * batch.shape[1]
+        wire_bpi = batch.nbytes / n_img
         w.fused.run()
         sync_images(w.fused)
         fused, loader = w.fused, w.loader
@@ -546,6 +586,12 @@ def streaming_metric(device, phase):
             "streaming_images_per_sec": round(n_img / med_fire, 2),
             "streaming_h2d_floor_images_per_sec": round(
                 n_img / med_put, 2),
+            "streaming_wire_format": str(batch.dtype),
+            "streaming_wire_bytes_per_image": round(wire_bpi, 1),
+            "streaming_link_mbps_probe": round(link_mbps, 1),
+            "streaming_link_mbps_probe_1byte": round(link_mbps_u8, 1),
+            "streaming_h2d_floor_images_per_sec_1byte": round(
+                floor_1byte, 2),
             "streaming_transfer_busy_fraction": round(
                 transfer_s / max(wall_s, 1e-9), 4),
             "streaming_window_efficiency": round(med_put / med_fire,
@@ -632,7 +678,13 @@ def main() -> None:
         "streaming_images_per_sec": None,
         "streaming_ratio": None,
         "streaming_h2d_floor_images_per_sec": None,
+        "streaming_wire_format": None,
+        "streaming_wire_bytes_per_image": None,
+        "streaming_link_mbps_probe": None,
+        "streaming_link_mbps_probe_1byte": None,
+        "streaming_h2d_floor_images_per_sec_1byte": None,
         "streaming_pipeline_efficiency": None,
+        "streaming_efficiency_basis": None,
         "streaming_transfer_busy_fraction": None,
         "streaming_window_efficiency": None,
         "streaming_minibatch_size": None,
@@ -688,13 +740,18 @@ def main() -> None:
         # tunnel's violent bandwidth swings (any cross-window
         # floor-vs-pipeline ratio measured 0.47..2.23 run-to-run on
         # the same code).  Compute-bound (co-located host): judge
-        # against the resident rate instead.
+        # against the resident rate instead.  The basis field names
+        # which definition produced the number — the two are NOT
+        # comparable, and cross-run diffs silently were (round-5
+        # records carried both meanings under one key).
         if h2d_rate <= images_per_sec:
             record["streaming_pipeline_efficiency"] = \
                 stream["streaming_transfer_busy_fraction"]
+            record["streaming_efficiency_basis"] = "transfer_busy"
         else:
             record["streaming_pipeline_efficiency"] = round(
                 stream_rate / images_per_sec, 4)
+            record["streaming_efficiency_basis"] = "vs_resident"
     phase("done")
     emit()
 

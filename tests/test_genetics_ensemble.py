@@ -102,6 +102,35 @@ class TestGeneticOptimizer:
         with pytest.raises(ValueError, match="no Tune"):
             GeneticOptimizer(lambda v: 0.0, {})
 
+    def test_history_len_and_double_run_no_duplicates(self):
+        """history holds exactly generations+1 entries (per-generation
+        rankings + the final evaluated population), and a second run()
+        on the same optimizer starts fresh instead of appending a
+        duplicate final-generation entry."""
+        prng.seed_all(99)
+        tunes = {"x": Tune(0.5, 0.0, 1.0)}
+        opt = GeneticOptimizer(lambda v: v["x"], tunes,
+                               population=6, generations=3)
+        opt.run()
+        assert len(opt.history) == 3 + 1
+        opt.run()
+        assert len(opt.history) == 3 + 1
+
+    def test_resumed_complete_run_no_duplicates(self, tmp_path):
+        """Resuming a COMPLETED run re-records only the final entry
+        the checkpoint never held — length stays generations+1."""
+        prng.seed_all(99)
+        tunes = {"x": Tune(0.5, 0.0, 1.0)}
+        state = str(tmp_path / "ga.json")
+        opt = GeneticOptimizer(lambda v: v["x"], tunes, population=6,
+                               generations=3, state_path=state)
+        opt.run()
+        assert len(opt.history) == 4
+        opt2 = GeneticOptimizer(lambda v: v["x"], tunes, population=6,
+                                generations=3, state_path=state)
+        opt2.run()
+        assert len(opt2.history) == 4
+
     def test_tunes_lr_of_real_workflow(self):
         """End-to-end: GA over the learning rate of a tiny workflow —
         the best LR must beat a pathologically small default."""
@@ -205,6 +234,37 @@ class TestGaEnsembleForge:
         np.testing.assert_array_equal(
             loaded[0]["params"]["fwd0_softmax"]["weights"],
             members[0]["params"]["fwd0_softmax"]["weights"])
+
+    def test_separator_in_names_fails_at_save_time(self, tmp_path):
+        """A '|' in a forward OR param name must fail when the artifact
+        is written, not when a consumer later loads it."""
+        from veles_tpu.ensemble import save_members
+        base = {"seed": 1, "valid_error": 1.0, "values": None,
+                "forward_names": ["ok"]}
+        bad_fwd = [dict(base, params={"a|b": {"w": np.zeros(2)}})]
+        with pytest.raises(ValueError, match="forward name"):
+            save_members(str(tmp_path / "f.npz"), bad_fwd)
+        bad_param = [dict(base, params={"ok": {"w|v": np.zeros(2)}})]
+        with pytest.raises(ValueError, match="param name"):
+            save_members(str(tmp_path / "p.npz"), bad_param)
+        assert not (tmp_path / "p.npz").exists()
+
+    def test_normalize_npz_path(self, tmp_path):
+        from veles_tpu.ensemble import (load_members,
+                                        normalize_npz_path,
+                                        save_members)
+        assert normalize_npz_path("a/b") == "a/b.npz"
+        assert normalize_npz_path("a/b.npz") == "a/b.npz"
+        members = [{"seed": 1, "valid_error": 1.0, "values": None,
+                    "forward_names": ["f"],
+                    "params": {"f": {"w": np.zeros(2, np.float32)}}}]
+        # suffix-less save reports the REAL on-disk path, and the same
+        # normalization makes the identical flag value load again
+        suffixless = str(tmp_path / "ens")
+        real = save_members(suffixless, members)
+        assert real == suffixless + ".npz"
+        assert load_members(normalize_npz_path(suffixless))[0][
+            "seed"] == 1
 
     def test_from_ga_requires_history(self):
         class Opt:

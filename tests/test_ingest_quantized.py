@@ -1,0 +1,510 @@
+"""Quantized uint8 ingest: the wire/HBM codec for byte-ranged datasets.
+
+The streaming path is hard link-bound (BENCH_r05: pipeline efficiency
+0.9988 against a ~115 img/s h2d floor at 2 bytes/pixel), so the codec
+ships byte-ranged datasets as uint8 — 1 byte/pixel on the wire, 4x
+less HBM when resident — and the fused step dequantizes on device
+(``x = q * scale + bias`` with the affine folded from the fitted
+Normalizer).  These tests pin the three contracts:
+
+- numerics: quantized and float ingest produce the same training
+  trajectory (within bf16 rounding) in BOTH streaming and resident
+  modes, including an MNIST-style conv workflow and a sharded mesh;
+- wire accounting: the streaming path moves <= half the bytes per
+  image of the bf16 wire (pixel payload exactly half), certified by
+  the ``stream_transfer_bytes`` hook;
+- residency: a byte-ranged dataset 4x over the float budget stays
+  HBM-resident as uint8 instead of falling off the streaming cliff.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import JaxDevice, NumpyDevice
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.loader.quantize import (AffineDequant, derive_dequant,
+                                       quantizable_source, to_uint8)
+from veles_tpu.normalization import make_normalizer
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+
+def byte_dataset(n_train=160, n_valid=40, shape=(8, 8, 1), n_classes=4,
+                 seed=7):
+    """A byte-ranged dataset pair: uint8 pixels + labels."""
+    rng = np.random.RandomState(seed)
+    total = n_train + n_valid
+    x = rng.randint(0, 256, (total,) + shape).astype(np.uint8)
+    y = rng.randint(0, n_classes, total).astype(np.int32)
+    return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
+
+
+def build_mlp(train, valid, quant, streaming=False, mb=20,
+              norm="mean_disp", epochs=2, budget=None):
+    prng.seed_all(1357)
+    kw = {}
+    if streaming:
+        kw["max_resident_bytes"] = 0
+    elif budget is not None:
+        kw["max_resident_bytes"] = budget
+    gd = {"learning_rate": 0.05, "gradient_moment": 0.9}
+    return StandardWorkflow(
+        loader_factory=lambda w: ArrayLoader(
+            w, train=train, valid=valid, minibatch_size=mb,
+            name="loader", normalization_type=norm,
+            quantized_ingest=quant, **kw),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": epochs},
+        name="quant_test")
+
+
+def valid_history(w):
+    return [h for h in w.decision.history if h["class"] == "validation"]
+
+
+def assert_same_trajectory(wa, wb, loss_atol=5e-3, err_slack=0):
+    ha, hb = valid_history(wa), valid_history(wb)
+    assert len(ha) == len(hb) >= 2
+    for a, b in zip(ha, hb):
+        assert abs(a["loss"] - b["loss"]) < loss_atol, (a, b)
+        assert abs(a["n_err"] - b["n_err"]) <= err_slack, (a, b)
+
+
+class TestCodec:
+    """The affine dequant reproduces the host normalizer bit-tight."""
+
+    @pytest.mark.parametrize("kind,params", [
+        ("none", {}),
+        ("linear", {}),
+        ("linear", {"lo": 0.0, "hi": 1.0}),
+        ("mean_disp", {}),
+        ("pointwise", {}),
+        ("external_mean", {"scale": 1.0 / 255.0}),
+    ])
+    def test_dequant_matches_normalizer(self, kind, params):
+        rng = np.random.RandomState(3)
+        q = rng.randint(0, 256, (64, 6, 6, 2)).astype(np.uint8)
+        norm = make_normalizer(kind, **params)
+        norm.fit(q)
+        want = norm.apply(q)          # the float-ingest pixels
+        dq = derive_dequant(norm)
+        assert dq is not None
+        got = dq.apply_host(q)        # what the traced prologue does
+        # one f32 ulp of composed-affine error, far inside bf16 ulp
+        span = max(float(np.abs(want).max()), 1.0)
+        np.testing.assert_allclose(got, want, atol=2e-5 * span)
+
+    def test_pre_scale_composes(self):
+        """decode-to-bytes loaders fold their /255 convention in."""
+        q = np.arange(256, dtype=np.uint8).reshape(16, 16)
+        dq = derive_dequant(None, pre_scale=1.0 / 255.0)
+        np.testing.assert_allclose(dq.apply_host(q),
+                                   q.astype(np.float32) / 255.0,
+                                   atol=1e-7)
+
+    def test_unfitted_normalizer_refused(self):
+        assert make_normalizer("mean_disp").affine_params() is None
+        assert derive_dequant(make_normalizer("linear")) is None
+
+    def test_quantizable_source_rules(self):
+        u8 = np.array([0, 255], np.uint8)
+        assert quantizable_source(u8, strict=True)
+        i64 = np.array([0, 255], np.int64)
+        assert not quantizable_source(i64, strict=True)   # auto: no
+        assert quantizable_source(i64, strict=False)      # True: yes
+        f_int = np.array([0.0, 12.0, 255.0], np.float32)
+        assert quantizable_source(f_int, strict=False)
+        f_frac = np.array([0.5], np.float32)
+        assert not quantizable_source(f_frac, strict=False)
+        assert not quantizable_source(np.array([-1], np.int32),
+                                      strict=False)
+
+    def test_to_uint8_validates(self):
+        np.testing.assert_array_equal(
+            to_uint8(np.array([0.0, 7.0, 255.0])),
+            np.array([0, 7, 255], np.uint8))
+        with pytest.raises(ValueError):
+            to_uint8(np.array([256.0]))
+
+    def test_explicit_true_on_float_data_is_loud(self):
+        train, valid = byte_dataset()
+        fx = train[0].astype(np.float32) + 0.25   # not byte-ranged
+        w = build_mlp((fx, train[1]), valid, quant=True)
+        with pytest.raises(ValueError, match="byte-ranged"):
+            w.initialize(device=JaxDevice(platform="cpu"))
+
+    def test_aliased_targets_stay_float(self):
+        """Autoencoder-style targets alias the input: auto-quantization
+        must stand down (the f32 loss consumes targets undequantized)."""
+        from veles_tpu.workflow import Workflow
+        train, valid = byte_dataset()
+        w = Workflow(name="t")
+        ld = ArrayLoader(w, train=train, minibatch_size=20, name="l",
+                         normalization_type="linear",
+                         targets_from_labels=True)  # target = input
+        ld.initialize(device=None)
+        assert ld.dequant is None
+        assert ld.original_data.mem.dtype == np.float32
+        assert ld.original_targets.mem is ld.original_data.mem
+
+
+class TestTrajectoryParity:
+    """Quantized and float ingest train identically (CPU backend)."""
+
+    def test_resident_matches_float(self):
+        train, valid = byte_dataset()
+        wq = build_mlp(train, valid, quant="auto")
+        wq.initialize(device=JaxDevice(platform="cpu"))
+        assert wq.loader.dequant is not None
+        assert wq.loader.original_data.mem.dtype == np.uint8
+        assert not wq.fused.streaming
+        wq.run()
+
+        wf = build_mlp(train, valid, quant=False)
+        wf.initialize(device=JaxDevice(platform="cpu"))
+        assert wf.loader.dequant is None
+        assert wf.loader.original_data.mem.dtype == np.float32
+        wf.run()
+        assert_same_trajectory(wq, wf)
+
+    def test_streaming_matches_float_and_resident(self):
+        train, valid = byte_dataset()
+        ws = build_mlp(train, valid, quant="auto", streaming=True)
+        ws.initialize(device=JaxDevice(platform="cpu"))
+        assert ws.fused.streaming
+        assert ws.loader.dequant is not None
+        # the wire must stay uint8 — no stream_dtype widening
+        ws.run()
+        assert ws.loader.superstep_data.dtype == np.uint8
+
+        wf = build_mlp(train, valid, quant=False, streaming=True)
+        wf.initialize(device=JaxDevice(platform="cpu"))
+        wf.run()
+        assert_same_trajectory(ws, wf)
+
+        wr = build_mlp(train, valid, quant="auto")
+        wr.initialize(device=JaxDevice(platform="cpu"))
+        wr.run()
+        assert_same_trajectory(ws, wr)
+
+    def test_mnist_conv_parity_both_modes(self):
+        """The acceptance workflow: an MNIST-style conv net over
+        byte-ranged 28x28 digits — quantized vs bf16/float ingest,
+        streaming AND resident, loss curves equal within bf16
+        rounding."""
+        prng.seed_all(2468)
+        train, valid, _ = synthetic_classification(
+            120, 40, (28, 28, 1), n_classes=10, seed=11)
+        tx = np.round(np.asarray(train[0]) * 255.0).astype(np.uint8)
+        vx = np.round(np.asarray(valid[0]) * 255.0).astype(np.uint8)
+        ty, vy = train[1], valid[1]
+        gd = {"learning_rate": 0.03, "gradient_moment": 0.9}
+        layers = [
+            {"type": "conv_tanh", "->": {"n_kernels": 4, "kx": 5,
+                                         "ky": 5}, "<-": gd},
+            {"type": "max_pooling", "->": {"kx": 2, "ky": 2}, "<-": {}},
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 32},
+             "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": gd},
+        ]
+
+        def build(quant, streaming):
+            prng.seed_all(1357)
+            kw = {"max_resident_bytes": 0} if streaming else {}
+            return StandardWorkflow(
+                loader_factory=lambda w: ArrayLoader(
+                    w, train=(tx, ty), valid=(vx, vy),
+                    minibatch_size=20, name="loader",
+                    normalization_type="linear",
+                    normalization_parameters={"lo": 0.0, "hi": 1.0},
+                    quantized_ingest=quant, **kw),
+                layers=layers,
+                decision_config={"max_epochs": 2},
+                name="mnist_conv_quant")
+
+        runs = {}
+        for quant in ("auto", False):
+            for streaming in (False, True):
+                w = build(quant, streaming)
+                w.initialize(device=JaxDevice(platform="cpu"))
+                assert w.fused.streaming == streaming
+                assert (w.loader.dequant is not None) == \
+                    (quant == "auto")
+                w.run()
+                runs[(quant, streaming)] = w
+        # bf16 rounding at these loss magnitudes (~2.3): |eps| ~ 2e-2;
+        # the codec lands orders of magnitude inside it
+        assert_same_trajectory(runs[("auto", False)],
+                               runs[(False, False)])
+        assert_same_trajectory(runs[("auto", True)],
+                               runs[(False, True)])
+        assert_same_trajectory(runs[("auto", True)],
+                               runs[("auto", False)])
+
+    def test_mesh_sharded_quantized_stream(self):
+        """uint8 superstep batches shard over the data axis; the
+        trajectory matches the unsharded quantized run."""
+        from veles_tpu.parallel import DataParallel
+        train, valid = byte_dataset()
+        w1 = build_mlp(train, valid, quant="auto", streaming=True)
+        w1.initialize(device=JaxDevice(platform="cpu"))
+        w1.run()
+
+        w4 = build_mlp(train, valid, quant="auto", streaming=True)
+        dp = DataParallel(w4, 4)
+        w4.initialize(device=dp.install())
+        assert w4.fused.streaming
+        assert w4.loader.dequant is not None
+        w4.run()
+        assert_same_trajectory(w1, w4, loss_atol=5e-3, err_slack=2)
+
+    def test_numpy_backend_host_fill_dequantizes(self):
+        """The eager/numpy golden path reads float minibatches: the
+        host fill applies the same affine the traced prologue does."""
+        train, valid = byte_dataset()
+        w = build_mlp(train, valid, quant="auto")
+        w.initialize(device=NumpyDevice())
+        ld = w.loader
+        assert ld.dequant is not None
+        assert ld.minibatch_data.mem.dtype == np.float32
+        w.loader.run()
+        idx = ld.minibatch_indices.map_read()
+        want = ld.dequant.apply_host(ld.original_data.mem[idx])
+        np.testing.assert_allclose(ld.minibatch_data.map_read(), want,
+                                   atol=1e-6)
+        np.testing.assert_allclose(
+            ld.normalized_host_rows(idx), want, atol=0)
+
+
+class TestWireAccounting:
+    """stream_transfer_bytes certifies what the codec actually moved."""
+
+    def test_uint8_wire_halves_bf16_bytes_per_image(self):
+        train, valid = byte_dataset(shape=(16, 16, 3))
+        wq = build_mlp(train, valid, quant="auto", streaming=True)
+        wq.initialize(device=JaxDevice(platform="cpu"))
+        wq.run()
+        wf = build_mlp(train, valid, quant=False, streaming=True)
+        wf.initialize(device=JaxDevice(platform="cpu"))
+        wf.run()
+        images = wq.fused.processed_images + \
+            wq.fused.processed_eval_images
+        images_f = wf.fused.processed_images + \
+            wf.fused.processed_eval_images
+        assert images == images_f > 0
+        bpi_q = wq.fused.stream_transfer_bytes / images
+        bpi_f = wf.fused.stream_transfer_bytes / images_f
+        px = 16 * 16 * 3
+        # CPU assembles the float wire in f32 (the compute dtype); the
+        # bf16 wire a TPU ships is exactly half of that
+        bpi_bf16 = bpi_f / 2
+        assert bpi_f >= px * 4            # f32 pixels + labels
+        # acceptance: <= half the bytes per image vs the bf16 wire
+        assert bpi_q <= 0.5 * bpi_f
+        assert bpi_q <= bpi_bf16
+        # and the pixel payload is EXACTLY 1 byte/px — half the bf16
+        # wire's 2, a quarter of the f32 wire's 4
+        assert wq.loader.superstep_data.dtype == np.uint8
+        assert wq.loader.superstep_data.nbytes == \
+            wq.loader.superstep_data.size
+        assert bpi_q < px * 1.5           # ~1 byte/px + label overhead
+
+    def test_resident_has_no_stream_bytes(self):
+        train, valid = byte_dataset()
+        w = build_mlp(train, valid, quant="auto")
+        w.initialize(device=JaxDevice(platform="cpu"))
+        w.run()
+        assert w.fused.stream_transfer_bytes == 0
+
+    def test_device_put_accounting_is_dtype_preserving(self):
+        dev = JaxDevice(platform="cpu")
+        base = dev.h2d_bytes
+        buf = dev.put(np.zeros((10, 10), np.uint8))
+        assert np.dtype(buf.dtype) == np.uint8     # no silent widening
+        assert dev.h2d_bytes - base == 100          # 1 byte/element
+        dev.put(np.zeros(4, np.float32))
+        assert dev.h2d_bytes - base == 116
+
+    def test_stream_transfer_bytes_pickles_with_default(self):
+        train, valid = byte_dataset()
+        w = build_mlp(train, valid, quant="auto", streaming=True)
+        w.initialize(device=JaxDevice(platform="cpu"))
+        w.run()
+        assert w.fused.stream_transfer_bytes > 0
+        state = pickle.loads(pickle.dumps(w.fused.__getstate__()))
+        state.pop("stream_transfer_bytes", None)
+        w.fused.__dict__.pop("stream_transfer_bytes", None)
+        w.fused.__setstate__(state)
+        assert w.fused.stream_transfer_bytes == 0
+
+
+class TestResidencyBudget:
+    """uint8 residency: 4x more dataset per byte of budget."""
+
+    def test_byte_ranged_4x_over_float_budget_stays_resident(self):
+        # 16384 uint8 elements: float ingest needs 64 KiB (4x OVER the
+        # 16 KiB budget -> streaming cliff); quantized needs exactly
+        # 16 KiB -> resident
+        n_train, n_valid = 192, 64
+        shape = (8, 8, 1)
+        assert (n_train + n_valid) * int(np.prod(shape)) == 16384
+        budget = 16384
+        train, valid = byte_dataset(n_train, n_valid, shape)
+
+        wf = build_mlp(train, valid, quant=False, budget=budget)
+        wf.initialize(device=JaxDevice(platform="cpu"))
+        assert not wf.loader.device_resident    # fell off the cliff
+        assert wf.fused.streaming
+
+        wq = build_mlp(train, valid, quant="auto", budget=budget)
+        wq.initialize(device=JaxDevice(platform="cpu"))
+        assert wq.loader.device_resident        # back on the chip
+        assert not wq.fused.streaming
+        assert wq.loader.original_data.mem.dtype == np.uint8
+        assert wq.loader.original_data.nbytes == budget
+        # and it trains
+        wq.run()
+        assert len(valid_history(wq)) == 2
+
+    def test_hbm_copy_is_uint8(self):
+        """The devmem the fused step gathers from is the 1-byte copy."""
+        train, valid = byte_dataset()
+        w = build_mlp(train, valid, quant="auto")
+        w.initialize(device=JaxDevice(platform="cpu"))
+        dataset = w.loader.original_data.unmap()
+        assert np.dtype(dataset.dtype) == np.uint8
+
+
+def make_image_tree(root, n_classes=3, per_class=12, size=(12, 12)):
+    from PIL import Image
+    rng = np.random.RandomState(33)
+    for split, n in (("train", per_class), ("validation", 4)):
+        for c in range(n_classes):
+            d = root / split / f"class{c}"
+            d.mkdir(parents=True, exist_ok=True)
+            for i in range(n):
+                base = int(200 * c / max(n_classes - 1, 1)) + 20
+                arr = np.clip(rng.normal(base, 30, size),
+                              0, 255).astype(np.uint8)
+                Image.fromarray(arr, "L").save(str(d / f"im{i}.png"))
+
+
+class TestImageLoaderQuantized:
+    """File loaders decode straight to uint8 under quantized ingest:
+    the /255 convention folds into the on-device dequant affine."""
+
+    def _build(self, tmp_path, quant, streaming="auto", epochs=2,
+               budget=None):
+        from veles_tpu.loader.image import ImageDirectoryLoader
+        prng.seed_all(9753)
+        kw = {}
+        if budget is not None:
+            kw["max_resident_bytes"] = budget
+        return StandardWorkflow(
+            loader_factory=lambda wf: ImageDirectoryLoader(
+                wf, data_dir=str(tmp_path), target_shape=(12, 12, 1),
+                minibatch_size=9, streaming=streaming,
+                quantized_ingest=quant, name="loader", **kw),
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 16},
+                 "<-": {"learning_rate": 0.1}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.1}},
+            ],
+            decision_config={"max_epochs": epochs},
+            name="img_quant")
+
+    def test_resident_quantized_matches_float(self, tmp_path):
+        make_image_tree(tmp_path)
+        wq = self._build(tmp_path, quant=True)
+        wq.initialize(device=JaxDevice(platform="cpu"))
+        ld = wq.loader
+        assert ld.dequant is not None
+        assert ld.original_data.mem.dtype == np.uint8
+        # decoded bytes dequantize to the float path's /255 pixels
+        np.testing.assert_allclose(
+            ld.normalized_host_rows(np.arange(4)),
+            ld.original_data.mem[:4].astype(np.float32) / 255.0,
+            atol=1e-7)
+        wq.run()
+
+        wf = self._build(tmp_path, quant="auto")   # auto = float here
+        wf.initialize(device=JaxDevice(platform="cpu"))
+        assert wf.loader.dequant is None
+        wf.run()
+        assert_same_trajectory(wq, wf)
+
+    def test_streaming_decode_raw_wire(self, tmp_path):
+        """streaming=True + quantized: files decode to uint8 on the
+        prefetch path and ship 1 byte/pixel; trajectory matches the
+        resident quantized run."""
+        make_image_tree(tmp_path)
+        ws = self._build(tmp_path, quant=True, streaming=True)
+        ws.initialize(device=JaxDevice(platform="cpu"))
+        ld = ws.loader
+        assert not ld.device_resident and ld.dequant is not None
+        assert ld.original_data.mem is None     # nothing pre-decoded
+        ws.run()
+        assert ws.loader.superstep_data.dtype == np.uint8
+        assert ws.fused.stream_transfer_bytes > 0
+
+        wr = self._build(tmp_path, quant=True)
+        wr.initialize(device=JaxDevice(platform="cpu"))
+        wr.run()
+        assert_same_trajectory(ws, wr)
+
+    def test_quantized_budget_estimate_is_1_byte(self, tmp_path):
+        """streaming='auto' sizes the decoded set at 1 byte/element
+        under quantized ingest — trees that stream at f32 stay
+        resident."""
+        make_image_tree(tmp_path, per_class=4)
+        n_imgs = 3 * (4 + 4)
+        budget = n_imgs * 12 * 12 * 2   # between 1x and 4x bytes
+        wf = self._build(tmp_path, quant=False, budget=budget)
+        wf.initialize(device=JaxDevice(platform="cpu"))
+        assert not wf.loader.device_resident    # f32 estimate: over
+
+        wq = self._build(tmp_path, quant=True, budget=budget)
+        wq.initialize(device=JaxDevice(platform="cpu"))
+        assert wq.loader.device_resident        # uint8 estimate: under
+        assert wq.loader.original_data.mem.dtype == np.uint8
+
+
+class TestSnapshotRoundtrip:
+    def test_dequant_rides_loader_pickle(self):
+        train, valid = byte_dataset()
+        w = build_mlp(train, valid, quant="auto")
+        w.initialize(device=JaxDevice(platform="cpu"))
+        ld = w.loader
+        assert ld.dequant is not None
+        state = pickle.loads(pickle.dumps(ld.__getstate__()))
+        ld2 = ArrayLoader.__new__(ArrayLoader)
+        ld2.__setstate__(state)
+        assert ld2.dequant is not None
+        np.testing.assert_array_equal(ld2.dequant.scale,
+                                      ld.dequant.scale)
+        np.testing.assert_array_equal(ld2.dequant.bias, ld.dequant.bias)
+        # pre-codec snapshots default the new attrs
+        for k in ("dequant", "quantized_ingest", "_quant_pre_scale"):
+            state.pop(k, None)
+        ld3 = ArrayLoader.__new__(ArrayLoader)
+        ld3.__setstate__(state)
+        assert ld3.dequant is None
+        assert ld3.quantized_ingest == "auto"
+        assert ld3._quant_pre_scale == 1.0
+
+    def test_affine_dequant_is_plain_state(self):
+        dq = AffineDequant(np.float32(0.5), np.zeros(3, np.float32))
+        dq2 = pickle.loads(pickle.dumps(dq))
+        np.testing.assert_array_equal(dq2.scale, dq.scale)
+        assert dq.nbytes == 16

@@ -192,7 +192,8 @@ def run_ensemble(args, workflow_file: str) -> int:
 
     from veles_tpu.backends import make_device
     from veles_tpu.ensemble import (EnsemblePredictor, EnsembleTrainer,
-                                    load_members, save_members)
+                                    load_members, normalize_npz_path,
+                                    save_members)
     from veles_tpu.launcher import load_workflow_module
     from veles_tpu.loader.base import VALID
     from veles_tpu.logger import setup_logging
@@ -233,12 +234,10 @@ def run_ensemble(args, workflow_file: str) -> int:
 
     import numpy as np
     if members is None:   # test-only invocation: load from disk
-        # numpy appends .npz on save — apply the SAME normalization
-        # here or a suffix-less --ensemble-file trains fine and then
-        # fails to load under the identical flag value
-        fname = args.ensemble_file \
-            if args.ensemble_file.endswith(".npz") \
-            else args.ensemble_file + ".npz"
+        # numpy appends .npz on save — normalize_npz_path applies the
+        # SAME rule save_members used, so a suffix-less
+        # --ensemble-file that trained fine also loads
+        fname = normalize_npz_path(args.ensemble_file)
         try:
             members = load_members(fname)
         except FileNotFoundError:
@@ -255,7 +254,14 @@ def run_ensemble(args, workflow_file: str) -> int:
         return 2
     off = ld.class_offset(VALID)
     try:
-        x = np.asarray(ld.original_data.map_read()[off:off + n])
+        # normalized_host_rows, not raw original_data: a quantized
+        # loader keeps uint8 bytes there and the members were trained
+        # on the dequantized float view
+        if hasattr(ld, "normalized_host_rows"):
+            x = np.asarray(
+                ld.normalized_host_rows(slice(off, off + n)))
+        else:
+            x = np.asarray(ld.original_data.map_read()[off:off + n])
         y = np.asarray(ld.original_labels.map_read()[off:off + n])
     except RuntimeError:
         print("--ensemble-test needs a loader with host-resident "

@@ -30,6 +30,12 @@ class Device(Logger):
 
     def __init__(self) -> None:
         self.compute_dtype = np.float32
+        #: cumulative host->device bytes shipped through ``put`` —
+        #: the transfer-accounting hook the quantized-ingest tests and
+        #: bench read.  ``put`` is dtype-preserving by contract: a
+        #: uint8 upload must stay 1 byte/element in HBM (the 4x
+        #: residency win), never silently widen to the compute dtype.
+        self.h2d_bytes = 0
 
     def put(self, array: np.ndarray) -> Any:
         return array
@@ -67,17 +73,27 @@ def _enable_persistent_compile_cache() -> None:
     process (reruns of bench.py, GA workers, the driver) load them in
     milliseconds.  Opt out with VELES_TPU_NO_COMPILE_CACHE=1; relocate
     with VELES_TPU_COMPILE_CACHE_DIR.
+
+    The default directory is namespaced by the jaxlib version:
+    deserializing an executable written by a different build (or a
+    torn entry from a process killed mid-write into a shared flat
+    dir) segfaults inside xla_extension — observed on this box as
+    general-protection faults that took out whole pytest runs.  A
+    version-keyed subdir never loads foreign entries and retires any
+    previously corrupted flat dir.
     """
     import os
     if os.environ.get("VELES_TPU_NO_COMPILE_CACHE"):
         return
-    path = os.environ.get(
-        "VELES_TPU_COMPILE_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "veles_tpu",
-                     "xla_cache"))
+    path = os.environ.get("VELES_TPU_COMPILE_CACHE_DIR")
     try:
-        os.makedirs(path, exist_ok=True)
         import jax
+        if path is None:
+            ver = getattr(jax, "__version__", "unknown")
+            path = os.path.join(
+                os.path.expanduser("~"), ".cache", "veles_tpu",
+                f"xla_cache-{ver}")
+        os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
     except Exception:  # noqa: BLE001 — cache is an optimization only
         pass
@@ -116,9 +132,11 @@ class JaxDevice(Device):
         # protocol lets callers mutate the host buffer right after
         # unmap() while async-dispatched steps still read it.  The copy
         # makes uploads value-snapshots, restoring the reference's
-        # enqueue-time semantics.
-        return self._jax.device_put(np.array(array, copy=True),
-                                    self.jax_device)
+        # enqueue-time semantics.  dtype-preserving: uint8 stays uint8
+        # in HBM (quantized ingest's 4x residency cut depends on it).
+        arr = np.array(array, copy=True)
+        self.h2d_bytes += arr.nbytes
+        return self._jax.device_put(arr, self.jax_device)
 
     def get(self, buf: Any) -> np.ndarray:
         return np.asarray(buf)

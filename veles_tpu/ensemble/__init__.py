@@ -10,7 +10,9 @@ keeping N live workflows.
 from veles_tpu.ensemble.core import EnsemblePredictor, EnsembleTrainer
 from veles_tpu.ensemble.packaging import (load_members,
                                           load_packed_ensemble,
+                                          normalize_npz_path,
                                           pack_ensemble, save_members)
 
 __all__ = ["EnsembleTrainer", "EnsemblePredictor", "save_members",
-           "load_members", "pack_ensemble", "load_packed_ensemble"]
+           "load_members", "pack_ensemble", "load_packed_ensemble",
+           "normalize_npz_path"]

@@ -26,10 +26,22 @@ _SEP = "|"
 _META = "__meta__"
 
 
+def normalize_npz_path(path: str) -> str:
+    """The real on-disk path of an npz artifact: numpy's ``savez``
+    appends ``.npz`` when missing — every save AND load site must
+    apply the same normalization or a suffix-less path trains fine
+    and then fails to load under the identical flag value."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def save_members(path: str, members: List[Dict[str, Any]]) -> str:
     """Serialize ``EnsembleTrainer.members`` to one compressed npz:
     ``m<i>|<forward>|<param>`` arrays plus a JSON metadata record
-    (seed, valid_error, forward_names, GA values)."""
+    (seed, valid_error, forward_names, GA values).
+
+    Both name components are validated for the ``|`` separator HERE,
+    at save time — a bad name must fail before the artifact is
+    published, not when a consumer later calls ``load_members``."""
     if not members:
         raise ValueError("empty ensemble")
     arrays: Dict[str, np.ndarray] = {}
@@ -44,12 +56,15 @@ def save_members(path: str, members: List[Dict[str, Any]]) -> str:
                 raise ValueError(f"forward name {fname!r} contains "
                                  f"{_SEP!r}")
             for pname, arr in p.items():
+                if _SEP in pname:
+                    raise ValueError(f"param name {pname!r} (forward "
+                                     f"{fname!r}) contains {_SEP!r}")
                 arrays[f"m{i}{_SEP}{fname}{_SEP}{pname}"] = \
                     np.asarray(arr)
     arrays[_META] = np.frombuffer(
         json.dumps(meta).encode(), np.uint8).copy()
     np.savez_compressed(path, **arrays)
-    return path if path.endswith(".npz") else path + ".npz"
+    return normalize_npz_path(path)
 
 
 def load_members(path: str) -> List[Dict[str, Any]]:
@@ -67,7 +82,9 @@ def load_members(path: str) -> List[Dict[str, Any]]:
             prefix = f"m{i}{_SEP}"
             for key in z.files:
                 if key.startswith(prefix):
-                    _, fname, pname = key.split(_SEP)
+                    # maxsplit guards legacy artifacts written before
+                    # save-time validation covered param names
+                    _, fname, pname = key.split(_SEP, 2)
                     params.setdefault(fname, {})[pname] = z[key]
             members.append(dict(md, params=params))
     return members

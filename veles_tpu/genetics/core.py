@@ -247,6 +247,14 @@ class GeneticOptimizer(Logger):
     # -- the loop ------------------------------------------------------
 
     def run(self) -> Tuple[Dict[str, Any], float]:
+        """One full GA run.  ``history`` afterwards holds exactly
+        ``generations + 1`` entries: the ranked population at the
+        START of each of the ``generations`` breeding steps, plus the
+        final bred-and-evaluated population appended after the loop.
+        Safe to call twice on one optimizer: a fresh (non-resumed)
+        run resets ``history`` first, and a resumed run restores it
+        from the checkpoint — either way the final-generation entry is
+        never duplicated."""
         resumed = self._load_state()
         if resumed is not None:
             start_gen, pop, fits = resumed
@@ -254,6 +262,10 @@ class GeneticOptimizer(Logger):
                       start_gen, self.state_path)
         else:
             start_gen = 0
+            # a second run() on the same optimizer starts a FRESH run:
+            # stale history would otherwise keep the previous run's
+            # generations+1 entries and duplicate the final append
+            self.history = []
             pop = self._initial_population()
             fits = self._fitness_many(pop)
             self._save_state(0, pop, fits)
@@ -279,7 +291,8 @@ class GeneticOptimizer(Logger):
         # the last bred population WAS evaluated — record it, or
         # history[-1] silently under-reports the final state (e.g.
         # EnsembleTrainer.from_ga would seed from the previous
-        # generation's ranking even when final offspring beat it)
+        # generation's ranking even when final offspring beat it);
+        # history length lands at generations + 1
         order = np.argsort(fits)
         pop, fits = pop[order], fits[order]
         self.history.append([(float(f), self._decode(g))

@@ -4,3 +4,6 @@ from veles_tpu.loader.base import Loader, TEST, VALID, TRAIN, CLASS_NAMES  # noq
 from veles_tpu.loader.fullbatch import (  # noqa: F401
     FullBatchLoader, ArrayLoader,
 )
+from veles_tpu.loader.quantize import (  # noqa: F401
+    AffineDequant, derive_dequant,
+)

@@ -84,6 +84,12 @@ class Loader(Unit, Distributable):
         self.superstep_data: Optional[np.ndarray] = None     # (k,mb,..)
         self.superstep_labels: Optional[np.ndarray] = None   # (k, mb)
         self.superstep_targets: Optional[np.ndarray] = None
+        #: quantized-ingest codec (loader/quantize.py AffineDequant):
+        #: when set, ``original_data`` / the streaming wire carry uint8
+        #: and the fused step dequantizes on device — the host eager
+        #: path applies the same affine in ``fill_minibatch``.  None =
+        #: the classic float ingest.
+        self.dequant = None
         self._prefetch_pool = None
         self._prefetch_future = None                # (key, Future)
         self.last_minibatch = Bool(False)   # last of the TRAIN class
@@ -105,6 +111,7 @@ class Loader(Unit, Distributable):
         # attrs introduced after a snapshot was written must default
         self.__dict__.setdefault("device_resident", True)
         self.__dict__.setdefault("prefetch_enabled", True)
+        self.__dict__.setdefault("dequant", None)
 
     # -- subclass contract --------------------------------------------
 
@@ -255,11 +262,15 @@ class Loader(Unit, Distributable):
         k, mb = idxs.shape
         data, labels, targets = self.assemble_rows(idxs.reshape(-1))
         if self.stream_dtype is not None and data is not None \
+                and np.issubdtype(data.dtype, np.floating) \
                 and data.dtype != self.stream_dtype:
             # data only: the trace's first op casts the pixels to the
             # compute dtype anyway.  Targets are NOT pre-cast — the
             # trace consumes them uncast (f32 loss), so rounding them
             # here would make streaming diverge from the resident path.
+            # Non-float rows are the quantized uint8 wire (1 byte/px,
+            # already narrower than any compute dtype) — casting them
+            # would undo the codec before the bytes ever hit the link.
             data = data.astype(self.stream_dtype)
 
         def shape_back(a):

@@ -48,6 +48,24 @@ class FullBatchLoader(Loader):
         self.normalization_parameters = kwargs.get(
             "normalization_parameters", {})
         self.normalizer = None
+        #: uint8 ingest codec mode (loader/quantize.py): "auto" keeps
+        #: byte-sourced (dtype uint8) datasets as uint8 — 1 byte/pixel
+        #: on the streaming wire, 4x less HBM when resident — and fuses
+        #: dequantization + normalization into the jitted step; True
+        #: additionally re-encodes any byte-RANGED source (integer or
+        #: integral-float values in [0, 255], validated); False always
+        #: pre-normalizes to float32 (the classic path).
+        self.quantized_ingest = kwargs.get("quantized_ingest", "auto")
+        #: mem -> float-view convention for quantized sources: the
+        #: float path computes ``normalizer.apply(mem * pre_scale)``
+        #: (image decoders set 1/255; raw-byte arrays leave 1.0)
+        self._quant_pre_scale = 1.0
+
+    def __setstate__(self, state: dict) -> None:
+        super().__setstate__(state)
+        # attrs introduced after a snapshot was written must default
+        self.__dict__.setdefault("quantized_ingest", "auto")
+        self.__dict__.setdefault("_quant_pre_scale", 1.0)
 
     @property
     def has_labels(self) -> bool:
@@ -58,11 +76,41 @@ class FullBatchLoader(Loader):
         return bool(self.original_targets)
 
     def post_load_data(self) -> None:
+        from veles_tpu.loader.quantize import (derive_dequant,
+                                               quantizable_source,
+                                               to_uint8)
+        self.dequant = None
+        pre = self.original_data.mem if self.original_data else None
+        want = self.quantized_ingest
+        targets_alias_data = pre is not None and \
+            bool(self.original_targets) and \
+            self.original_targets.mem is pre
+        # Decide quantization BEFORE normalizing — the point is never
+        # materializing the float copy.  Autoencoder-style aliased
+        # targets stay float: the trace consumes targets undequantized
+        # (f32 loss), so a uint8 target store would change the loss.
+        quantize = bool(want) and pre is not None \
+            and not targets_alias_data \
+            and quantizable_source(pre, strict=(want == "auto"))
+        if want is True and pre is not None and not quantize:
+            why = "targets alias the input data" if targets_alias_data \
+                else f"dtype {pre.dtype} is not byte-ranged"
+            raise ValueError(
+                f"{self.name}: quantized_ingest=True but the dataset "
+                f"cannot ride the uint8 codec ({why})")
+        pre_scale = self._quant_pre_scale
         if self.normalization_type == "none" and self.normalizer is None:
+            if quantize:
+                self.original_data.mem = to_uint8(pre)
+                self.dequant = derive_dequant(None, pre_scale)
+            elif pre is not None and pre_scale != 1.0:
+                # raw-byte load_data but no codec: recover the float
+                # view the rest of the framework expects
+                self.original_data.mem = \
+                    pre.astype(np.float32) * np.float32(pre_scale)
             return
         from veles_tpu.normalization import make_normalizer
         from veles_tpu.loader.base import TRAIN
-        pre = self.original_data.mem
         if self.normalizer is None:
             if self.class_lengths[TRAIN] == 0:
                 raise ValueError(
@@ -71,9 +119,28 @@ class FullBatchLoader(Loader):
                     f"to fit on (class_lengths={self.class_lengths})")
             self.normalizer = make_normalizer(
                 self.normalization_type, **self.normalization_parameters)
-            self.normalizer.fit(pre[self.class_offset(TRAIN):])
-        targets_alias_data = bool(self.original_targets) and \
-            self.original_targets.mem is pre
+            fit_view = pre[self.class_offset(TRAIN):]
+            if pre_scale != 1.0:
+                # the normalizer's statistics must describe the FLOAT
+                # view (raw * pre_scale) its affine will reproduce
+                fit_view = fit_view.astype(np.float32) * \
+                    np.float32(pre_scale)
+            self.normalizer.fit(fit_view)
+        if quantize:
+            dq = derive_dequant(self.normalizer, pre_scale)
+            if dq is not None:
+                # bytes stay bytes; normalization folds into the fused
+                # step's on-device dequantization prologue
+                self.original_data.mem = to_uint8(pre)
+                self.dequant = dq
+                return
+            if want is True:
+                raise ValueError(
+                    f"{self.name}: quantized_ingest=True but "
+                    f"normalizer {self.normalizer.kind!r} exposes no "
+                    f"affine_params() to fold into the dequantization")
+        if pre_scale != 1.0:
+            pre = pre.astype(np.float32) * np.float32(pre_scale)
         self.original_data.mem = self.normalizer.apply(pre)
         if targets_alias_data:  # autoencoder: target = normalized input
             self.original_targets.mem = self.original_data.mem
@@ -101,13 +168,14 @@ class FullBatchLoader(Loader):
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
         if self.original_data and self.original_data.mem is not None \
-                and self.original_data.mem.nbytes > \
+                and self.original_data.nbytes > \
                 self._resident_budget():
             self.device_resident = False
-            self.info("dataset %.1f GiB exceeds the %.1f GiB HBM "
+            self.info("dataset %.1f GiB (%s) exceeds the %.1f GiB HBM "
                       "residency budget — streaming superstep batches "
                       "from host",
-                      self.original_data.mem.nbytes / 2 ** 30,
+                      self.original_data.nbytes / 2 ** 30,
+                      self.original_data.mem.dtype,
                       self._resident_budget() / 2 ** 30)
         resident = self.on_device and self.device_resident
         for v in (self.original_data, self.original_labels,
@@ -120,7 +188,11 @@ class FullBatchLoader(Loader):
     def create_minibatch_data(self) -> None:
         mb = self.max_minibatch_size
         shape = (mb,) + tuple(self.original_data.shape[1:])
-        self.minibatch_data.mem = np.zeros(shape, self.original_data.dtype)
+        # host minibatches are always the dequantized float view — the
+        # eager/numpy units were built for normalized pixels, not bytes
+        mb_dtype = np.float32 if self.dequant is not None \
+            else self.original_data.dtype
+        self.minibatch_data.mem = np.zeros(shape, mb_dtype)
         if self.has_labels:
             self.minibatch_labels.mem = np.zeros(mb, np.int32)
         if self.has_targets:
@@ -142,7 +214,7 @@ class FullBatchLoader(Loader):
         # the eager wiring must still be able to fill host minibatches
         idx = self.minibatch_indices.map_read()
         self.minibatch_data.map_invalidate()[:] = \
-            self.original_data.map_read()[idx]
+            self.normalized_host_rows(idx)
         if self.has_labels:
             self.minibatch_labels.map_invalidate()[:] = \
                 self.original_labels.map_read()[idx]
@@ -150,9 +222,21 @@ class FullBatchLoader(Loader):
             self.minibatch_targets.map_invalidate()[:] = \
                 self.original_targets.map_read()[idx]
 
+    def normalized_host_rows(self, indices) -> np.ndarray:
+        """Float32 normalized rows for GLOBAL ``indices`` (or a
+        slice), regardless of the ingest codec — for host consumers
+        (eager minibatch fill, ensemble prediction, DBN pretraining)
+        that would otherwise read raw uint8 under quantized ingest."""
+        rows = self.original_data.map_read()[indices]
+        if self.dequant is not None:
+            rows = self.dequant.apply_host(rows)
+        return rows
+
     def assemble_rows(self, indices: np.ndarray):
         """Streaming-mode assembly: slice the host arrays (already
-        normalized by post_load_data)."""
+        normalized by post_load_data — or raw uint8 under quantized
+        ingest, which IS the wire format; the fused step dequantizes
+        on device)."""
         data = self.original_data.mem[indices]
         labels = self.original_labels.mem[indices] \
             if self.has_labels else None

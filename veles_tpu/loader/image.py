@@ -30,9 +30,12 @@ _IMG_EXT = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".ppm", ".tif",
 
 
 def decode_image(path: str, target_shape: Tuple[int, int, int],
-                 normalize: bool = True) -> np.ndarray:
+                 normalize: bool = True, raw: bool = False) -> np.ndarray:
     """path -> float32 HWC array resized to target_shape; grayscale or
-    RGB by the target's channel count."""
+    RGB by the target's channel count.  ``raw=True`` keeps the
+    decoder's native uint8 bytes (no /255, no float cast) — the
+    quantized-ingest wire format; the ``normalize`` convention then
+    moves into the loader's dequantization affine instead."""
     from PIL import Image
 
     h, w, c = target_shape
@@ -40,10 +43,10 @@ def decode_image(path: str, target_shape: Tuple[int, int, int],
         im = im.convert("L" if c == 1 else "RGB")
         if im.size != (w, h):
             im = im.resize((w, h), Image.BILINEAR)
-        arr = np.asarray(im, np.float32)
+        arr = np.asarray(im, np.uint8 if raw else np.float32)
     if c == 1:
         arr = arr[..., None]
-    if normalize:
+    if normalize and not raw:
         arr /= 255.0
     return arr
 
@@ -81,8 +84,20 @@ class FileListImageLoader(FullBatchLoader):
         self._paths: List[str] = []
         self._stream = False
         self._decode_pool = None
+        #: quantized ingest (explicit opt-in only for file loaders —
+        #: "auto" keys off the SOURCE dtype, and decode's float output
+        #: would never match): decode straight to uint8 and fold the
+        #: /255 convention + normalizer into the on-device dequant
+        self._decode_raw = self.quantized_ingest is True
+        if self._decode_raw:
+            self._quant_pre_scale = 1.0 / 255.0 if self.normalize \
+                else 1.0
 
     _unpicklable = FullBatchLoader._unpicklable + ("_decode_pool",)
+
+    def __setstate__(self, state: dict) -> None:
+        super().__setstate__(state)
+        self.__dict__.setdefault("_decode_raw", False)
 
     def _flat_entries(self) -> List[Tuple[str, int]]:
         """All (path, label) laid out [test | valid | train] to match
@@ -101,8 +116,12 @@ class FileListImageLoader(FullBatchLoader):
         self._paths = [p for p, _ in entries]
         self.original_labels.mem = np.asarray(
             [l for _, l in entries], np.int32)
+        # uint8 ingest keeps decoded pixels at 1 byte/element — a 4x
+        # cut against the residency budget, so image trees that fell
+        # off the streaming cliff at f32 stay resident quantized
         est_bytes = len(entries) * \
-            int(np.prod(self.target_shape)) * 4
+            int(np.prod(self.target_shape)) * \
+            (1 if self._decode_raw else 4)
         self._stream = self.streaming is True or (
             self.streaming == "auto" and
             est_bytes > self._resident_budget())
@@ -119,7 +138,7 @@ class FileListImageLoader(FullBatchLoader):
 
     def _decode_one(self, i: int) -> np.ndarray:
         return decode_image(self._paths[i], self.target_shape,
-                            self.normalize)
+                            self.normalize, raw=self._decode_raw)
 
     def _decode_batch(self, indices: np.ndarray) -> np.ndarray:
         """Decode rows for global ``indices``, fanning PIL decodes out
@@ -143,6 +162,10 @@ class FileListImageLoader(FullBatchLoader):
             # instead of re-decoding every superstep
             return super().assemble_rows(indices)
         data = self._decode_batch(indices)
+        if self.dequant is not None:
+            # quantized wire: raw uint8 rows ship as-is; the fused
+            # step's prologue applies /255 + normalizer on device
+            return data, self.original_labels.mem[indices], None
         if self.normalizer is not None:
             data = self.normalizer.apply(data)
         return data, self.original_labels.mem[indices], None
@@ -153,6 +176,8 @@ class FileListImageLoader(FullBatchLoader):
             return
         idx = self.minibatch_indices.map_read()
         data, labels, _ = self.assemble_rows(idx)
+        if self.dequant is not None:
+            data = self.dequant.apply_host(data)
         self.minibatch_data.map_invalidate()[:] = data
         self.minibatch_labels.map_invalidate()[:] = labels
 
@@ -162,7 +187,12 @@ class FileListImageLoader(FullBatchLoader):
         if not self._stream:
             super().post_load_data()
             return
+        from veles_tpu.loader.quantize import derive_dequant
+        self.dequant = None
         if self.normalization_type == "none" and self.normalizer is None:
+            if self._decode_raw:
+                self.dequant = derive_dequant(None,
+                                              self._quant_pre_scale)
             return
         # fit the normalizer on a bounded sample of TRAIN files — the
         # full set cannot be materialized by definition here
@@ -179,10 +209,24 @@ class FileListImageLoader(FullBatchLoader):
             n_fit = min(n_train, self.norm_sample)
             sample = off + np.unique(
                 np.linspace(0, n_train - 1, n_fit).astype(np.int64))
+            view = self._decode_batch(sample)
+            if self._decode_raw:
+                # statistics must describe the FLOAT view the dequant
+                # affine reproduces (raw * pre_scale)
+                view = view.astype(np.float32) * \
+                    np.float32(self._quant_pre_scale)
             self.normalizer = make_normalizer(
                 self.normalization_type,
                 **self.normalization_parameters)
-            self.normalizer.fit(self._decode_batch(sample))
+            self.normalizer.fit(view)
+        if self._decode_raw:
+            self.dequant = derive_dequant(self.normalizer,
+                                          self._quant_pre_scale)
+            if self.dequant is None:
+                raise ValueError(
+                    f"{self.name}: quantized_ingest=True but "
+                    f"normalizer {self.normalizer.kind!r} exposes no "
+                    f"affine_params()")
 
     def create_minibatch_data(self) -> None:
         if not self._stream:

@@ -91,6 +91,20 @@ class Vector:
         return int(np.prod(self.shape))
 
     @property
+    def nbytes(self) -> int:
+        """Bytes of the freshest buffer — what residency budgeting and
+        transfer accounting charge.  Dtype-preserving end to end: a
+        quantized uint8 dataset reports 1 byte/element here, uploads
+        at 1 byte/element (``Device.put``), and sits in HBM at 1
+        byte/element — a quarter of its float32 view."""
+        if self._mem is not None:
+            return int(self._mem.nbytes)
+        if self._devmem is not None:
+            return int(np.dtype(self._devmem.dtype).itemsize
+                       * int(np.prod(tuple(self._devmem.shape))))
+        return 0
+
+    @property
     def sample_size(self) -> int:
         """Elements per leading-axis sample (reference: Vector.sample_size)."""
         s = self.shape

@@ -40,6 +40,18 @@ class NormalizerBase:
     def apply(self, data: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
+    def affine_params(self):
+        """``(scale, bias)`` such that ``apply(x) == x * scale + bias``
+        elementwise (scalars or arrays broadcasting over the sample
+        shape), or ``None`` when the map is not affine or not fitted
+        yet.  This is what the quantized-ingest path folds into the
+        on-device dequantization prologue (loader/quantize.py): a
+        byte-ranged dataset ships as uint8 and the jitted step applies
+        ``u8 * scale + bias`` instead of the host pre-normalizing to
+        float32.  Computed in float64 so the composed affine stays
+        within one f32 ulp of the two-op host ``apply``."""
+        return None
+
     def state(self) -> dict:
         return {}
 
@@ -48,6 +60,9 @@ class NormalizerBase:
 class NoneNormalizer(NormalizerBase):
     def apply(self, data):
         return np.asarray(data, np.float32)
+
+    def affine_params(self):
+        return 1.0, 0.0
 
 
 @register("linear")
@@ -71,6 +86,13 @@ class LinearNormalizer(NormalizerBase):
         x = (np.asarray(data, np.float32) - self.dmin) / span
         return x * (self.hi - self.lo) + self.lo
 
+    def affine_params(self):
+        if self.dmin is None:
+            return None
+        span = (np.float64(self.dmax) - np.float64(self.dmin)) or 1.0
+        scale = (np.float64(self.hi) - np.float64(self.lo)) / span
+        return float(scale), float(self.lo - self.dmin * scale)
+
     def state(self):
         return {"dmin": self.dmin, "dmax": self.dmax}
 
@@ -93,6 +115,14 @@ class MeanDispNormalizer(NormalizerBase):
         if self.mean is None:
             self.fit(data)
         return (np.asarray(data, np.float32) - self.mean) / self.std
+
+    def affine_params(self):
+        if self.mean is None:
+            return None
+        scale = 1.0 / np.asarray(self.std, np.float64)
+        return (scale.astype(np.float32),
+                (-np.asarray(self.mean, np.float64) * scale)
+                .astype(np.float32))
 
     def state(self):
         return {"mean": self.mean, "std": self.std}
@@ -118,6 +148,13 @@ class ExternalMeanNormalizer(NormalizerBase):
             self.fit(data)
         return (np.asarray(data, np.float32) - self.mean) * self.scale
 
+    def affine_params(self):
+        if self.mean is None:
+            return None
+        return (float(self.scale),
+                (-np.asarray(self.mean, np.float64) * self.scale)
+                .astype(np.float32))
+
     def state(self):
         return {"mean": self.mean, "scale": self.scale}
 
@@ -141,6 +178,17 @@ class PointwiseNormalizer(NormalizerBase):
         span = self.dmax - self.dmin
         span = np.where(span == 0, 1.0, span)
         return 2.0 * (np.asarray(data, np.float32) - self.dmin) / span - 1.0
+
+    def affine_params(self):
+        if self.dmin is None:
+            return None
+        span = (np.asarray(self.dmax, np.float64)
+                - np.asarray(self.dmin, np.float64))
+        span = np.where(span == 0, 1.0, span)
+        scale = 2.0 / span
+        return (scale.astype(np.float32),
+                (-np.asarray(self.dmin, np.float64) * scale - 1.0)
+                .astype(np.float32))
 
     def state(self):
         return {"dmin": self.dmin, "dmax": self.dmax}

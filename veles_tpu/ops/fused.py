@@ -92,6 +92,12 @@ class FusedStepRunner(AcceleratedUnit):
         #: (bench.py): on a link-bound host a perfect pipeline spends
         #: ~all its wall here, and the remainder is framework overhead
         self.stream_transfer_seconds = 0.0
+        #: cumulative host->device bytes the streaming path shipped
+        #: (pixel batches + targets/labels) — the wire-format
+        #: accounting: divided by processed images it certifies what
+        #: the codec actually moved per sample (uint8 ingest must show
+        #: <= half the bf16 wire, a quarter of f32)
+        self.stream_transfer_bytes = 0
 
     _unpicklable = AcceleratedUnit._unpicklable + (
         "_train_step", "_eval_step", "_params", "_opt", "mesh",
@@ -166,6 +172,20 @@ class FusedStepRunner(AcceleratedUnit):
         mixed = cd != jnp.float32
         out_shape = tuple(forwards[-1].output.shape)
         streaming = self.streaming
+        dq = getattr(self.loader, "dequant", None)
+        if dq is not None:
+            # quantized ingest: batch rows arrive as uint8 (from the
+            # HBM-resident store or the streaming wire) and the affine
+            # dequantize+normalize runs HERE, as the traced prologue —
+            # f32 arithmetic first (host normalization order), then
+            # forward_pass casts to the compute dtype as usual
+            q_scale = jnp.asarray(dq.scale, jnp.float32)
+            q_bias = jnp.asarray(dq.bias, jnp.float32)
+
+        def ingest(x):
+            if dq is None:
+                return x
+            return x.astype(jnp.float32) * q_scale + q_bias
 
         def cast(tree):
             if not mixed:
@@ -224,6 +244,7 @@ class FusedStepRunner(AcceleratedUnit):
                 else:
                     indices, mask, lr = xs
                     x, target = gather(dataset, target_store, indices)
+                x = ingest(x)
                 cparams = cast(params)
                 out, residuals = forward_pass(cparams, x, rc, True)
                 m = metrics_of(out, target, mask)
@@ -294,7 +315,7 @@ class FusedStepRunner(AcceleratedUnit):
                 acc, conf, _, rc = carry
                 indices, mask = xs
                 x, target = gather(dataset, target_store, indices)
-                out, _ = forward_pass(cparams, x, rc, False)
+                out, _ = forward_pass(cparams, ingest(x), rc, False)
                 m = metrics_of(out, target, mask)
                 m.pop("err_output")
                 acc, conf = accumulate(acc, conf, m)
@@ -313,7 +334,7 @@ class FusedStepRunner(AcceleratedUnit):
             def body(carry, xs):
                 acc, conf, _, rc = carry
                 x, target, mask = xs
-                out, _ = forward_pass(cparams, x, rc, False)
+                out, _ = forward_pass(cparams, ingest(x), rc, False)
                 m = metrics_of(out, target, mask)
                 m.pop("err_output")
                 acc, conf = accumulate(acc, conf, m)
@@ -380,11 +401,16 @@ class FusedStepRunner(AcceleratedUnit):
         self.streaming = not getattr(self.loader, "device_resident",
                                      True)
         if self.streaming and self.device.is_jax:
-            # assemble streaming batches directly in the compute dtype
-            # (prefetch thread): the trace's first op is this cast
-            # anyway, and doing it host-side halves H2D bytes on the
-            # bf16 platforms where the transfer is the bottleneck
-            self.loader.stream_dtype = np.dtype(self._resolved_dtype())
+            if getattr(self.loader, "dequant", None) is None:
+                # assemble streaming batches directly in the compute
+                # dtype (prefetch thread): the trace's first op is this
+                # cast anyway, and doing it host-side halves H2D bytes
+                # on the bf16 platforms where the transfer bottlenecks
+                self.loader.stream_dtype = \
+                    np.dtype(self._resolved_dtype())
+            # else: quantized ingest — the wire is uint8 (1 byte/px,
+            # half the bf16 wire) and the traced prologue dequantizes;
+            # a stream_dtype cast would widen the bytes back out
         if self.mesh is not None:
             # sharded jit partitions poorly around custom-call kernels;
             # units with hand kernels (LRN) must take their XLA form
@@ -488,6 +514,11 @@ class FusedStepRunner(AcceleratedUnit):
                 f"{'targets' if self._has_targets() else 'labels'})")
         dst = self._batch_sharding if self.mesh is not None \
             else self.device.jax_device
+        # wire-byte accounting BEFORE the upload rebinds xb/tb: what
+        # the codec actually ships per sample (uint8 ingest = 1
+        # byte/pixel; bf16 = 2; f32 = 4) — bench.py and the codec
+        # tests divide this by processed images
+        self.stream_transfer_bytes += int(xb.nbytes) + int(tb.nbytes)
         t_transfer = time.perf_counter()
         xb = jax.device_put(xb, dst)
         tb = jax.device_put(tb, dst)
@@ -645,6 +676,7 @@ class FusedStepRunner(AcceleratedUnit):
         self.__dict__.setdefault("lr_rates", None)
         self.__dict__.setdefault("streaming", False)
         self.__dict__.setdefault("stream_transfer_seconds", 0.0)
+        self.__dict__.setdefault("stream_transfer_bytes", 0)
         from collections import deque
         if self.__dict__.get("_inflight") is None:  # dropped by pickle
             self._inflight = deque()

@@ -42,7 +42,13 @@ class MeshJaxDevice(JaxDevice):
 
     def put(self, array) -> Any:
         import numpy as np
-        return self._jax.device_put(np.array(array, copy=True), self._repl)
+        # dtype-preserving like JaxDevice.put: a quantized loader's
+        # uint8 dataset replicates at 1 byte/element per device, and
+        # the sharded streaming path ships uint8 superstep batches
+        # (each device receives only its slice of every minibatch)
+        arr = np.array(array, copy=True)
+        self.h2d_bytes += arr.nbytes
+        return self._jax.device_put(arr, self._repl)
 
     def zeros(self, shape, dtype=None) -> Any:
         import numpy as np
