@@ -24,7 +24,15 @@ XLA materializes more, so the printed ceiling is an upper bound):
 - activation/dropout: fused into their producers — zero extra traffic
   (dropout's bf16 mask residual counted: one write + one read).
 
-Usage: python scripts/layer_roofline.py [mb]
+Usage: python scripts/layer_roofline.py [mb] [--measure] [--iters K]
+
+``--measure`` (round-5 VERDICT next #3 — finish the ceiling proof):
+runs each AlexNet conv's fwd+bwd ALONE on the default jax device at
+the same shapes/dtypes the fused step uses (bf16 compute on TPU, f32
+master params, per-iteration param carry inside a lax.scan so XLA
+cannot hoist the loop-invariant work) and prints measured us/sample
+next to the analytic floor — per-layer MEASURED MXU efficiency
+replacing the previously inferred ~62% residual in docs/perf.md.
 """
 
 from __future__ import annotations
@@ -41,7 +49,7 @@ ACT = 2                 # bf16 activation bytes
 P32 = 4                 # f32 param bytes
 
 
-def build_forwards(mb: int):
+def build_workflow(mb: int):
     from veles_tpu import prng
     from veles_tpu.backends import NumpyDevice
     from veles_tpu.loader.synthetic import SyntheticClassificationLoader
@@ -59,7 +67,11 @@ def build_forwards(mb: int):
         decision_config={"max_epochs": 1},
         name="RooflineShapes")
     w.initialize(device=NumpyDevice())   # shape resolution only
-    return w.forwards
+    return w
+
+
+def build_forwards(mb: int):
+    return build_workflow(mb).forwards
 
 
 def layer_rows(forwards, mb: int):
@@ -120,9 +132,117 @@ def layer_rows(forwards, mb: int):
     return rows
 
 
+def measure_conv_layers(w, rows, mb: int, iters: int = 8,
+                        repeats: int = 3):
+    """Each conv's fwd+bwd ALONE on the device, scanned.
+
+    The scan carries the PARAMS (a tiny SGD step per iteration, like
+    the fused trace) so the per-iteration work has a genuine data
+    dependency — a loop-invariant fwd+bwd would be hoisted out of the
+    scan and the timing would measure one iteration no matter what
+    ``iters`` says.  The timing barrier is a host fetch of the updated
+    bias (bytes-tiny, data-dependent on every iteration).  Chain-head
+    convs skip err_input exactly like the production step
+    (need_err_input=False), so conv1's number excludes the dgrad the
+    real step never computes.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from veles_tpu.backends import make_device
+
+    device = make_device("auto")
+    if not device.is_jax:
+        raise SystemExit("--measure needs a jax device (TPU/XLA:CPU)")
+    cd = jnp.dtype(device.compute_dtype)
+    mixed = cd != jnp.float32
+    floor_by_name = {r["name"]: r for r in rows}
+    out = []
+    for i, (u, gd) in enumerate(zip(w.forwards, w.gds)):
+        if "Conv" not in type(u).__name__ or gd is None:
+            continue
+        first = i == 0 and gd.can_skip_err_input
+
+        def cast(tree):
+            if not mixed:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(cd) if a.dtype == jnp.float32
+                else a, tree)
+
+        def step(params, x, _u=u, _gd=gd, _first=first, _cast=cast):
+            def body(p, _):
+                cp = _cast(p)
+                y, res = _u.apply_fwd(cp, x, rng=None, train=True)
+                err = (y * jnp.asarray(1e-3, y.dtype))  # dep chain
+                if _first:
+                    _, grads = _gd.backward_from_saved(
+                        cp, res, err, need_err_input=False)
+                else:
+                    _, grads = _gd.backward_from_saved(cp, res, err)
+                p = {k: p[k] - 1e-6 * grads[k].astype(jnp.float32)
+                     for k in p}
+                return p, None
+            params, _ = lax.scan(body, params, None, length=iters)
+            return params
+
+        fn = jax.jit(step, donate_argnums=(0,))
+        params = {k: device.put(np.asarray(v, np.float32))
+                  for k, v in u.gather_params().items()}
+        x_host = np.random.default_rng(5).standard_normal(
+            (mb,) + tuple(u.input.shape[1:])).astype(np.float32)
+        x = device.put(x_host.astype(cd) if mixed else x_host)
+        params = fn(params, x)               # compile + warmup
+        np.asarray(params["bias"])           # drain
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            params = fn(params, x)
+            np.asarray(params["bias"])       # the honest barrier
+            times.append(time.perf_counter() - t0)
+        us = float(np.median(times)) / (iters * mb) * 1e6
+        floor = floor_by_name[u.name]
+        out.append({
+            "name": u.name,
+            "floor_us": floor["floor_us"],
+            "t_mxu_us": floor["t_mxu_us"],
+            "measured_us": us,
+            "efficiency": floor["floor_us"] / us if us > 0 else 0.0,
+        })
+    return out
+
+
+def print_measured(measured, device_kind: str):
+    print(f"\n# measured per-conv fwd+bwd, isolated, scanned "
+          f"({device_kind}); efficiency = analytic floor / measured")
+    print(f"{'layer':<22}{'floor_us':>10}{'measured_us':>13}"
+          f"{'efficiency':>12}")
+    for r in measured:
+        print(f"{r['name']:<22}{r['floor_us']:>10.2f}"
+              f"{r['measured_us']:>13.2f}"
+              f"{100 * r['efficiency']:>11.1f}%")
+    tot_floor = sum(r["floor_us"] for r in measured)
+    tot_meas = sum(r["measured_us"] for r in measured)
+    print(f"{'all convs':<22}{tot_floor:>10.2f}{tot_meas:>13.2f}"
+          f"{100 * tot_floor / tot_meas:>11.1f}%")
+
+
 def main():
-    mb = int(sys.argv[1]) if len(sys.argv) > 1 else 512
-    forwards = build_forwards(mb)
+    measure, iters, positional = False, 8, []
+    argv = iter(sys.argv[1:])
+    for a in argv:
+        if a == "--measure":
+            measure = True
+        elif a == "--iters":
+            iters = int(next(argv))
+        else:
+            positional.append(a)
+    mb = int(positional[0]) if positional else 512
+    w = build_workflow(mb)
+    forwards = w.forwards
     rows = layer_rows(forwards, mb)
     total_floor = sum(r["floor_us"] for r in rows)
     total_flops = sum(r["train_gflops"] for r in rows)
@@ -149,6 +269,12 @@ def main():
         print(f"measured at mb=512 (round-5 bench): ~14100 img/s = "
               f"~70.9 us/sample -> ~48.9% MFU; gap to floor = "
               f"{70.9 / total_floor:.2f}x")
+    if measure:
+        from veles_tpu.backends import make_device
+        measured = measure_conv_layers(w, rows, mb, iters=iters)
+        kind = getattr(make_device("auto").jax_device, "device_kind",
+                       "cpu")
+        print_measured(measured, kind)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,156 @@
+"""Device-resident ensemble inference (ISSUE 3 tentpole): the
+vmapped member-stacked engine (ops/fused.py EnsembleEvalEngine) must
+match the numpy member-loop oracle to f32 tolerance in BOTH data paths
+(streaming per-batch upload and HBM-resident gather), and
+EnsemblePredictor's ``device=`` knob must route between them."""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import JaxDevice, NumpyDevice
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.ensemble import (EnsembleEvalEngine, EnsemblePredictor,
+                                EnsembleTrainer)
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+
+def conv_member_factory(train, valid):
+    """A small conv net — the engine must vmap conv/pool/dense/softmax,
+    not just MLPs."""
+    def factory():
+        return StandardWorkflow(
+            loader_factory=lambda wf: ArrayLoader(
+                wf, train=train, valid=valid, minibatch_size=40,
+                name="loader"),
+            layers=[
+                {"type": "conv_relu",
+                 "->": {"n_kernels": 6, "kx": 3, "ky": 3,
+                        "padding": 1},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}},
+                {"type": "max_pooling",
+                 "->": {"kx": 2, "ky": 2, "sliding": 2}, "<-": {}},
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 24},
+                 "<-": {"learning_rate": 0.1}},
+                {"type": "softmax", "->": {"output_sample_shape": 4},
+                 "<-": {"learning_rate": 0.1}},
+            ],
+            decision_config={"max_epochs": 2}, name="member")
+    return factory
+
+
+@pytest.fixture(scope="module")
+def trained_ensemble():
+    prng.seed_all(77)
+    train, valid, _ = synthetic_classification(
+        240, 80, (10, 10, 1), n_classes=4, seed=42)
+    factory = conv_member_factory(train, valid)
+    trainer = EnsembleTrainer(factory,
+                              lambda: JaxDevice(platform="cpu"),
+                              n_members=3, base_seed=999)
+    members = trainer.train()
+    return factory, members, valid
+
+
+class TestEngineParity:
+    def test_streaming_matches_host_oracle(self, trained_ensemble):
+        """One vmapped dispatch == members x layers of host calls, to
+        f32 tolerance (XLA:CPU computes in f32 like the oracle)."""
+        factory, members, (x, y) = trained_ensemble
+        pred = EnsemblePredictor(factory,
+                                 lambda: JaxDevice(platform="cpu"),
+                                 members)                # auto -> engine
+        assert pred.engine is not None
+        p_dev = pred.predict_proba(x[:40])
+        p_host = pred.predict_proba_host(x[:40])
+        np.testing.assert_allclose(p_dev, p_host, rtol=2e-4,
+                                   atol=2e-6)
+        np.testing.assert_allclose(p_dev.sum(-1), 1.0, atol=1e-5)
+        # error accounting rides the same donated-carry scoring path
+        assert pred.error_pct(x, y, chunk=32) == pytest.approx(
+            _host_error(pred, x, y), abs=1e-6)
+
+    def test_resident_matches_host_oracle(self, trained_ensemble):
+        """The HBM-resident gather variant: the split uploads once,
+        every call gathers by index on device."""
+        factory, members, (x, y) = trained_ensemble
+        pred = EnsemblePredictor(factory,
+                                 lambda: JaxDevice(platform="cpu"),
+                                 members)
+        eng = pred.engine
+        eng.attach_dataset(x, y)
+        idx = np.arange(40)
+        np.testing.assert_allclose(
+            eng.predict_proba_resident(idx),
+            pred.predict_proba_host(x[:40]), rtol=2e-4, atol=2e-6)
+        assert eng.error_pct_resident(chunk=32) == pytest.approx(
+            _host_error(pred, x, y), abs=1e-6)
+        # ragged tail: a chunk that does not divide the split must be
+        # mask-padded, not retraced or miscounted
+        assert eng.error_pct_resident(chunk=33) == pytest.approx(
+            _host_error(pred, x, y), abs=1e-6)
+
+    def test_ragged_streaming_chunk(self, trained_ensemble):
+        factory, members, (x, y) = trained_ensemble
+        pred = EnsemblePredictor(factory,
+                                 lambda: JaxDevice(platform="cpu"),
+                                 members)
+        assert pred.error_pct(x, y, chunk=37) == pytest.approx(
+            _host_error(pred, x, y), abs=1e-6)
+
+
+def _host_error(pred, x, y) -> float:
+    wrong = int((np.argmax(pred.predict_proba_host(x), -1)
+                 != y).sum())
+    return 100.0 * wrong / len(x)
+
+
+class TestDeviceKnob:
+    def test_host_mode_has_no_engine(self, trained_ensemble):
+        factory, members, _ = trained_ensemble
+        pred = EnsemblePredictor(factory,
+                                 lambda: JaxDevice(platform="cpu"),
+                                 members, device="host")
+        assert pred.engine is None
+
+    def test_numpy_backend_auto_stays_host(self, trained_ensemble):
+        factory, members, _ = trained_ensemble
+        pred = EnsemblePredictor(factory, NumpyDevice, members)
+        assert pred.engine is None   # no jax device -> oracle path
+
+    def test_bad_knob_rejected(self, trained_ensemble):
+        factory, members, _ = trained_ensemble
+        with pytest.raises(ValueError, match="device"):
+            EnsemblePredictor(factory, NumpyDevice, members,
+                              device="gpu")
+
+    def test_engine_rejects_numpy_device(self, trained_ensemble):
+        factory, members, _ = trained_ensemble
+        pred = EnsemblePredictor(factory, NumpyDevice, members)
+        with pytest.raises(ValueError, match="jax device"):
+            EnsembleEvalEngine(pred._forwards,
+                               [m["params"] for m in members],
+                               NumpyDevice())
+
+    def test_single_dispatch_counter(self, trained_ensemble):
+        """The tentpole property itself: ONE device computation per
+        predict_proba batch, not members x layers.  Counted via the
+        engine's jitted callable."""
+        factory, members, (x, _) = trained_ensemble
+        pred = EnsemblePredictor(factory,
+                                 lambda: JaxDevice(platform="cpu"),
+                                 members)
+        eng = pred.engine
+        calls = {"n": 0}
+        inner = eng._predict
+
+        def counting(params, xb):
+            calls["n"] += 1
+            return inner(params, xb)
+
+        eng._predict = counting
+        pred.predict_proba(x[:24])
+        assert calls["n"] == 1

@@ -266,3 +266,43 @@ class TestForgeMarketplace:
         finally:
             server.shutdown()
             t.join(timeout=5)
+
+
+class TestAtomicCompileCacheWrites:
+    """PR-3 hardening: jax's LRUCache.put (eviction disabled — the
+    default) writes persistent compile-cache entries with a bare
+    write_bytes, so concurrent same-key compiles tear the entry and
+    every later reader hard-aborts deserializing it (reproduced
+    deterministically on this box).  backends.py patches the write to
+    pid-tempfile + os.replace."""
+
+    def test_patch_applied_and_atomic(self, tmp_path):
+        from veles_tpu.backends import (_enable_persistent_compile_cache,
+                                        _harden_compile_cache_writes)
+        _enable_persistent_compile_cache()  # idempotent; applies patch
+        _harden_compile_cache_writes()      # second call = no-op
+        from jax._src import lru_cache as lc
+        assert getattr(lc.LRUCache.put, "_veles_atomic", False)
+        cache = lc.LRUCache(str(tmp_path / "c"), max_size=-1)
+        assert not cache.eviction_enabled   # the unlocked path
+        cache.put("k1", b"\x01" * 64)
+        suffix = lc._CACHE_SUFFIX
+        files = sorted(p.name for p in (tmp_path / "c").iterdir())
+        assert f"k1{suffix}" in files
+        assert not any(".tmp" in f for f in files)  # replace, not write
+        assert cache.get("k1") == b"\x01" * 64
+        # existing entries are never rewritten (jax's documented put
+        # semantics survive the patch)
+        cache.put("k1", b"\x02" * 64)
+        assert cache.get("k1") == b"\x01" * 64
+
+    def test_cache_dir_is_era_namespaced(self):
+        """The default dir retires anything the old non-atomic writers
+        could have torn: version + `-aw` era tag."""
+        import jax
+
+        from veles_tpu.backends import _enable_persistent_compile_cache
+        _enable_persistent_compile_cache()
+        d = jax.config.jax_compilation_cache_dir
+        assert d is not None and d.endswith("-aw")
+        assert jax.__version__ in d

@@ -1,0 +1,70 @@
+"""The driver-contract multichip dryrun must be hermetic to CPU
+(round-5 VERDICT missing #1): MULTICHIP_r05 went red because the
+dryrun targets a virtual CPU mesh yet left the process's default JAX
+backend on the TPU, so a transient libtpu breakage killed an eager op
+the check never needed the chip for.  These tests run the dryrun in
+the CPU suite every CI run AND prove that the non-CPU backend cannot
+be touched even when the environment offers one."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+class TestHermeticDryrun:
+    def test_dryrun_2_devices_in_process(self):
+        """The contract call, in the CPU suite's own process (jax is
+        already up on XLA:CPU with 8 virtual devices — the in-process
+        fast path)."""
+        from __graft_entry__ import dryrun_multichip
+        dryrun_multichip(2)
+
+    def test_dryrun_pins_itself_with_noncpu_poisoned(self):
+        """A fresh process with NO JAX_PLATFORMS pin from the caller
+        and the TPU plugin poisoned (a nonexistent libtpu path): the
+        dryrun must pin itself to CPU before JAX initializes.  If the
+        pinning ever regresses, the poisoned backend turns this red
+        instead of letting TPU-environment weather decide."""
+        env = os.environ.copy()
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("JAX_PLATFORM_NAME", None)
+        env.pop("XLA_FLAGS", None)
+        env["TPU_LIBRARY_PATH"] = "/nonexistent/poisoned-libtpu.so"
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "__graft_entry__.py"), "2"],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=600)
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert "dryrun_multichip(2) OK" in res.stdout
+
+    def test_dryrun_reexecs_when_backend_unsuitable(self):
+        """jax already initialized with a single CPU device (no
+        virtual-device flag): the dryrun cannot build a 2-mesh in this
+        process and must re-exec a pinned child instead of failing."""
+        code = (
+            "import jax; jax.devices()\n"
+            "from __graft_entry__ import dryrun_multichip\n"
+            "dryrun_multichip(2)\n"
+            "print('REEXEC_OK')\n")
+        env = os.environ.copy()
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)   # exactly 1 cpu device
+        res = subprocess.run([sys.executable, "-c", code], env=env,
+                             cwd=REPO, capture_output=True, text=True,
+                             timeout=600)
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert "REEXEC_OK" in res.stdout
+
+    def test_host_device_flags(self):
+        from __graft_entry__ import _host_device_flags
+        assert _host_device_flags("", 4) == \
+            "--xla_force_host_platform_device_count=4"
+        assert _host_device_flags(
+            "--xla_force_host_platform_device_count=2 --other", 8) == \
+            "--xla_force_host_platform_device_count=8 --other"
+        kept = "--xla_force_host_platform_device_count=8"
+        assert _host_device_flags(kept, 2) == kept

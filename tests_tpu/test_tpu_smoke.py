@@ -302,6 +302,135 @@ class TestSnapshotResumeOnChip:
         assert got_hist == ref_hist
 
 
+class TestEnsembleEngineOnChip:
+    def test_vmapped_ensemble_matches_host_oracle_at_bf16(
+            self, tpu_device):
+        """ISSUE 3 tentpole on the real chip: N members served as ONE
+        vmapped bf16 dispatch must agree with the f32 numpy member
+        loop at bf16 tolerance, in both data paths."""
+        from veles_tpu.datasets import synthetic_classification
+        from veles_tpu.ensemble import EnsemblePredictor, \
+            EnsembleTrainer
+        from veles_tpu.loader import ArrayLoader
+
+        prng.seed_all(4321)
+        train, valid, _ = synthetic_classification(
+            200, 60, (12, 12, 1), n_classes=4, seed=13)
+
+        def factory():
+            return StandardWorkflow(
+                loader_factory=lambda wf: ArrayLoader(
+                    wf, train=train, valid=valid, minibatch_size=50,
+                    name="loader"),
+                layers=[
+                    {"type": "conv_relu",
+                     "->": {"n_kernels": 8, "kx": 3, "ky": 3,
+                            "padding": 1},
+                     "<-": {"learning_rate": 0.05}},
+                    {"type": "max_pooling",
+                     "->": {"kx": 2, "ky": 2, "sliding": 2},
+                     "<-": {}},
+                    {"type": "softmax",
+                     "->": {"output_sample_shape": 4},
+                     "<-": {"learning_rate": 0.1}}],
+                decision_config={"max_epochs": 2}, name="member")
+
+        trainer = EnsembleTrainer(factory, lambda: tpu_device,
+                                  n_members=3, base_seed=888)
+        members = trainer.train()
+        pred = EnsemblePredictor(factory, lambda: tpu_device, members)
+        assert pred.engine is not None          # auto -> chip engine
+        x, y = valid
+        p_dev = pred.predict_proba(x[:50])
+        p_host = pred.predict_proba_host(x[:50])
+        # bf16 matmuls vs f32 host: the fused-vs-numpy trajectory
+        # tolerance discipline, per-element on probabilities
+        np.testing.assert_allclose(p_dev, p_host, rtol=0.05,
+                                   atol=0.02)
+        np.testing.assert_allclose(p_dev.sum(-1), 1.0, atol=0.02)
+        # both engines score the same split within bf16 slack
+        e_dev = pred.error_pct(x, y)
+        eng = pred.engine
+        eng.attach_dataset(x, y)
+        e_res = eng.error_pct_resident()
+        assert abs(e_dev - e_res) <= 5.0, (e_dev, e_res)
+
+
+class TestChipEvaluatorGA:
+    def test_ga_auto_trains_genomes_on_the_chip(self, tpu_device,
+                                                tmp_path):
+        """ISSUE 3 acceptance: a GA run with `-b auto` and N>1 workers
+        on a single-chip image executes genome evaluations ON the TPU
+        — one evaluator process owns the chip (its hello says so), the
+        prep workers are host threads, and no second device client
+        ever exists."""
+        import sys
+        import textwrap
+
+        from veles_tpu.genetics.pool import ChipEvaluatorPool
+
+        wf = tmp_path / "wf.py"
+        wf.write_text(textwrap.dedent("""
+            from veles_tpu.models import mnist
+
+            def run(launcher):
+                launcher.create_workflow(mnist.create_workflow)
+                launcher.initialize()
+                launcher.run()
+        """))
+        cfg = tmp_path / "cfg.py"
+        cfg.write_text(textwrap.dedent("""
+            from veles_tpu.config import root
+            from veles_tpu.genetics import Tune
+
+            root.mnist.loader = {"minibatch_size": 25, "n_train": 100,
+                                 "n_valid": 40}
+            root.mnist.decision = {"max_epochs": 1}
+            root.mnist.layers = [
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": Tune(16, 8, 32)},
+                 "<-": {"learning_rate": Tune(0.1, 0.01, 1.0)}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": 10},
+                 "<-": {"learning_rate": 0.1}},
+            ]
+        """))
+        good = {"mnist.layers[0]['->']['output_sample_shape']": 16,
+                "mnist.layers[0]['<-']['learning_rate']": 0.1}
+        other = dict(good)
+        other["mnist.layers[0]['<-']['learning_rate']"] = 0.25
+        cmd = [sys.executable, "-m", "veles_tpu.genetics.worker",
+               "--serve", str(wf), str(cfg), "-b", "auto",
+               "-s", "1234"]
+        pool = ChipEvaluatorPool(cmd, workers=2, timeout=600)
+        try:
+            try:
+                pool.start()
+            except RuntimeError as e:
+                # this pytest process already holds a chip client; a
+                # strictly exclusive platform then refuses the
+                # evaluator child.  That is contention between TEST
+                # harness and evaluator, not a policy failure — in a
+                # real GA run the parent never touches the device
+                # (run_optimizer builds no Launcher).
+                pytest.skip(f"chip admits one client on this "
+                            f"platform ({e}); pool protocol covered "
+                            f"by the CPU tier")
+            if not pool.is_accelerator:
+                pytest.skip(f"evaluator child could not claim the "
+                            f"accelerator: {pool.hello}")
+            # `auto` landed the ONE evaluator on the accelerator —
+            # this is the assertion that the GA uses the chip
+            assert pool.hello["platform"] != "cpu"
+            pid = pool.hello["pid"]
+            fits = pool.evaluate_many([good, other])
+            assert all(np.isfinite(f) for f in fits), fits
+            # same single chip-owning process served both genomes
+            assert pool.hello["pid"] == pid
+        finally:
+            pool.close()
+
+
 class TestStreamingAccountingOnChip:
     def test_streaming_trains_and_accounts_transfers(self, tpu_device):
         """The streaming path on the real chip (the benchmark's

@@ -28,8 +28,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("files", nargs="+",
                    help="workflow.py [config.py ...]")
     p.add_argument("-b", "--backend", default="auto",
-                   choices=["auto", "tpu", "jax", "cpu", "numpy"],
-                   help="execution backend (default: auto)")
+                   choices=["auto", "tpu", "jax", "cpu", "numpy",
+                            "tpu-evaluator"],
+                   help="execution backend (default: auto); "
+                        "'tpu-evaluator' is --optimize-only: one "
+                        "chip-owning evaluator process + host prep "
+                        "workers")
     p.add_argument("-s", "--seed", type=int, default=1234)
     p.add_argument("--snapshot", default=None,
                    help="resume from a snapshot file")
@@ -52,10 +56,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GA-tune config values wrapped in Tune(...): "
                         "population size : generations (e.g. 8:5)")
     p.add_argument("--ga-workers", type=int, default=0,
-                   help="parallel genome-evaluation subprocesses "
-                        "(0 = auto: up to 4 with -b cpu/numpy, else 1 "
-                        "— a possibly-present TPU chip is exclusive "
-                        "and must not be probed from the GA parent)")
+                   help="parallel GA workers (0 = auto: up to 4). "
+                        "With -b cpu/numpy these are genome-evaluation "
+                        "subprocesses; with -b auto/tpu-evaluator they "
+                        "are host-side prep threads feeding ONE "
+                        "chip-owning evaluator process — the chip is "
+                        "exclusive and is never probed from the GA "
+                        "parent")
     p.add_argument("--ga-eval-timeout", type=float, default=3600,
                    help="seconds before a genome's training run is "
                         "killed and scored inf (default 3600)")
@@ -76,6 +83,12 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="FILE",
                    help="member store for --ensemble-train/test "
                         "(default: ensemble.npz)")
+    p.add_argument("--ensemble-device", default="auto",
+                   choices=["auto", "host"],
+                   help="--ensemble-test prediction engine: 'auto' = "
+                        "one vmapped member-stacked dispatch on the "
+                        "chip when the backend is jax; 'host' = the "
+                        "numpy member-loop oracle")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run plus "
                         "a per-layer FLOPs table into DIR")
@@ -123,6 +136,11 @@ def main(argv=None) -> int:
         from veles_tpu.logger import add_jsonl_sink
         atexit.register(add_jsonl_sink(args.log_events))
 
+    if args.backend == "tpu-evaluator" and not args.optimize:
+        print("-b tpu-evaluator is a GA execution mode — it needs "
+              "--optimize POP:GEN", file=sys.stderr)
+        return 2
+
     if args.optimize:
         # NO Launcher here: constructing one acquires the device, and
         # an exclusive TPU grabbed by the GA parent would lock every
@@ -156,9 +174,11 @@ def main(argv=None) -> int:
 def _ga_worker_count(args) -> int:
     if args.ga_workers:
         return max(1, args.ga_workers)
-    # the TPU chip is a single-client resource: genome evaluations on
-    # it must serialize; CPU evaluations parallelize across cores
-    if args.backend in ("numpy", "cpu"):
+    # cpu/numpy workers are evaluation subprocesses; auto/tpu-evaluator
+    # workers are prep threads for the single chip-owning evaluator —
+    # both parallelize across host cores.  Explicit tpu/jax serializes
+    # (the chip admits one client and the user asked for direct mode).
+    if args.backend in ("numpy", "cpu", "auto", "tpu-evaluator"):
         import os
         return min(4, max(1, (os.cpu_count() or 2) // 2))
     return 1
@@ -168,16 +188,24 @@ def _resolve_ga_execution(backend: str, workers: int):
     """(workers, worker_backend) such that parallel genome workers can
     never race to initialize an exclusive TPU chip:
 
-    - ``auto`` + parallel workers -> workers evaluate on ``cpu`` (the
-      chip, if any, stays unclaimed; host cores do the fan-out);
+    - ``auto`` -> ``tpu-evaluator`` mode: ONE evaluator subprocess owns
+      the device (TPU when present) and executes every genome on it;
+      the N workers become host-side prep threads that never construct
+      a device, so there is no race by construction.  When the
+      evaluator's hello reports no accelerator, run_optimizer falls
+      back to the classic ``cpu`` subprocess fan-out;
+    - explicit ``tpu-evaluator`` -> the same, honored even without an
+      accelerator (the evaluator then runs genomes on XLA:CPU,
+      still one process, compile caches warm across genomes);
     - explicit ``tpu``/``jax`` + parallel workers -> serialized to 1
-      worker (honors the device choice; the chip admits one client);
+      direct worker (honors the per-genome-subprocess choice; the
+      chip admits one client);
     - ``cpu``/``numpy`` parallelize freely.
     """
+    if backend in ("auto", "tpu-evaluator"):
+        return max(1, workers), "tpu-evaluator"
     if workers <= 1 or backend in ("numpy", "cpu"):
         return workers, backend
-    if backend == "auto":
-        return workers, "cpu"
     return 1, backend
 
 
@@ -245,7 +273,8 @@ def run_ensemble(args, workflow_file: str) -> int:
                   f"exist (train one first with --ensemble-train N)",
                   file=sys.stderr)
             return 2
-    pred = EnsemblePredictor(factory, device_factory, members)
+    pred = EnsemblePredictor(factory, device_factory, members,
+                             device=args.ensemble_device)
     ld = pred.workflow.loader
     n = ld.class_lengths[VALID]
     if not n:
@@ -268,17 +297,15 @@ def run_ensemble(args, workflow_file: str) -> int:
               "original_data/labels (full-batch); streaming loaders "
               "are not supported here", file=sys.stderr)
         return 2
-    # evaluate in minibatch-sized chunks: one giant batch would
-    # materialize every member's full-split activations at once
-    chunk = max(1, ld.max_minibatch_size)
-    wrong = 0
-    for i in range(0, n, chunk):
-        wrong += int((pred.predict(x[i:i + chunk]) !=
-                      y[i:i + chunk]).sum())
-    err = 100.0 * wrong / n
+    # minibatch-sized chunks in both engines: one giant batch would
+    # materialize every member's full-split activations at once (the
+    # device engine additionally keeps ONE compiled shape this way)
+    err = pred.error_pct(x, y, chunk=max(1, ld.max_minibatch_size))
     print(json.dumps({
         "members": len(members),
         "ensemble_valid_error_pct": round(err, 4),
+        "ensemble_eval_engine": "device" if pred.engine is not None
+        else "host",
         "member_valid_errors_pct": [round(m["valid_error"], 4)
                                     for m in members]}))
     return 0
@@ -288,10 +315,20 @@ def run_optimizer(args, workflow_file: str, config_files, overrides) \
         -> int:
     """GA mode (reference: veles --optimize): genes are Tune(...)
     markers in the config tree; fitness is the best validation error
-    of a full (short) training run.  Each genome runs in its OWN
-    subprocess (veles_tpu/genetics/worker.py) — isolating the global
-    ``root`` mutation and any crash — fanned out over --ga-workers;
-    --ga-state checkpoints every generation and resumes."""
+    of a full (short) training run.  Two execution modes, resolved by
+    _resolve_ga_execution:
+
+    - subprocess fan-out (cpu/numpy, or explicit tpu/jax serialized):
+      each genome runs in its OWN worker subprocess
+      (veles_tpu/genetics/worker.py), isolating the global ``root``
+      mutation and any crash, fanned out over --ga-workers;
+    - ``tpu-evaluator`` (the ``auto`` default): ONE persistent
+      evaluator subprocess owns the accelerator and executes every
+      genome on it (genetics/pool.py), the workers become host prep
+      threads — the framework's own hyperparameter search finally
+      trains on the chip with N>1 workers and no device race.
+
+    --ga-state checkpoints every generation and resumes in both."""
     import json
     import subprocess
     from concurrent.futures import ThreadPoolExecutor
@@ -301,7 +338,7 @@ def run_optimizer(args, workflow_file: str, config_files, overrides) \
     from veles_tpu.logger import setup_logging
 
     # no Launcher in this process (the device must stay unclaimed for
-    # the workers), so logging is configured directly
+    # the evaluator/workers), so logging is configured directly
     setup_logging(10 if args.verbose else 20)
 
     tunes = find_tunes(root)
@@ -313,12 +350,44 @@ def run_optimizer(args, workflow_file: str, config_files, overrides) \
     pop, gen = int(pop_s), int(gen_s or 3)
     workers, worker_backend = _resolve_ga_execution(
         args.backend, _ga_worker_count(args))
-    if worker_backend != args.backend:
-        print(f"--optimize: {workers} parallel workers with -b auto "
-              f"evaluate on cpu so they cannot race for an exclusive "
-              f"TPU chip (pass -b tpu to serialize on the chip "
-              f"instead)", file=sys.stderr)
-    elif workers == 1 and args.ga_workers > 1:
+
+    pool = None
+    if worker_backend == "tpu-evaluator":
+        from veles_tpu.genetics.pool import ChipEvaluatorPool
+        serve_cmd = [sys.executable, "-m",
+                     "veles_tpu.genetics.worker", "--serve",
+                     workflow_file, *config_files, *overrides,
+                     "-b", "auto", "-s", str(args.seed)]
+        if args.verbose:
+            serve_cmd.append("-v")
+        pool = ChipEvaluatorPool(serve_cmd, workers=workers,
+                                 timeout=args.ga_eval_timeout,
+                                 seed=args.seed)
+        try:
+            hello = pool.start()
+        except Exception as e:  # noqa: BLE001 — fall back, not die
+            print(f"--optimize: chip evaluator failed to start ({e})",
+                  file=sys.stderr)
+            pool.close()
+            pool = None
+            hello = None
+        if pool is not None and not pool.is_accelerator \
+                and args.backend == "auto":
+            # no chip behind `auto`: the classic CPU fan-out
+            # parallelizes better than one XLA:CPU evaluator process
+            print(f"--optimize: no accelerator visible (evaluator "
+                  f"landed on {pool.platform}) — falling back to "
+                  f"{workers} cpu evaluation subprocesses",
+                  file=sys.stderr)
+            pool.close()
+            pool = None
+        if pool is None:
+            worker_backend = "cpu"
+        else:
+            print(f"--optimize: tpu-evaluator mode — evaluator pid "
+                  f"{hello['pid']} owns {pool.platform}; {workers} "
+                  f"prep workers feed its queue", file=sys.stderr)
+    if pool is None and workers == 1 and args.ga_workers > 1:
         print(f"--optimize: -b {args.backend} admits one client — "
               f"--ga-workers {args.ga_workers} serialized to 1",
               file=sys.stderr)
@@ -327,7 +396,7 @@ def run_optimizer(args, workflow_file: str, config_files, overrides) \
                 workflow_file, *config_files, *overrides,
                 "-b", worker_backend, "-s", str(args.seed)]
 
-    def evaluate_one(values) -> float:
+    def evaluate_one_subprocess(values) -> float:
         cmd = base_cmd + ["--values", json.dumps(values)]
         try:
             res = subprocess.run(cmd, capture_output=True, text=True,
@@ -343,15 +412,26 @@ def run_optimizer(args, workflow_file: str, config_files, overrides) \
                   file=sys.stderr)
             return float("inf")
 
-    def evaluate_many(values_list):
-        with ThreadPoolExecutor(workers) as pool:
-            return list(pool.map(evaluate_one, values_list))
+    def evaluate_many_subprocess(values_list):
+        with ThreadPoolExecutor(workers) as tp:
+            return list(tp.map(evaluate_one_subprocess, values_list))
 
-    opt = GeneticOptimizer(evaluate_one, tunes, population=pop,
-                           generations=gen,
-                           evaluate_many=evaluate_many,
-                           state_path=args.ga_state)
-    best, fitness = opt.run()
+    if pool is not None:
+        evaluate_one, evaluate_many = pool.evaluate_one, \
+            pool.evaluate_many
+    else:
+        evaluate_one, evaluate_many = evaluate_one_subprocess, \
+            evaluate_many_subprocess
+
+    try:
+        opt = GeneticOptimizer(evaluate_one, tunes, population=pop,
+                               generations=gen,
+                               evaluate_many=evaluate_many,
+                               state_path=args.ga_state)
+        best, fitness = opt.run()
+    finally:
+        if pool is not None:
+            pool.close()
     import math
     if not math.isfinite(fitness):
         print("--optimize: every evaluation failed (fitness inf); "

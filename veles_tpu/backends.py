@@ -65,6 +65,67 @@ class NumpyDevice(Device):
     backend_name = "numpy"
 
 
+def _harden_compile_cache_writes() -> None:
+    """Make the on-disk XLA cache's entry writes ATOMIC (idempotent).
+
+    jax's ``LRUCache.put`` with eviction disabled (the default,
+    ``jax_compilation_cache_max_size=-1``) takes no lock and writes
+    the entry with a direct ``write_bytes`` — NOT temp-file+rename.
+    Two processes compiling the same program concurrently (parallel GA
+    workers, a bench phase next to a test run) interleave their writes
+    and leave a torn executable on disk; every later process that gets
+    a cache hit on that key then ABORTS inside xla_extension while
+    deserializing it (observed on this box as deterministic
+    ``Fatal Python error: Aborted`` at the same test, session after
+    session, until the directory was wiped — the same failure family
+    as the round-5 foreign-version GPFs).  The patch routes the write
+    through a pid-suffixed temp file + ``os.replace`` in the same
+    directory, so a reader sees either no entry or a complete one,
+    and a writer killed mid-write leaves only a dead ``.tmp`` that is
+    never served.  The eviction-enabled path already serializes both
+    sides under a file lock and is left alone.
+    """
+    try:
+        from jax._src import lru_cache as lc
+    except Exception:  # noqa: BLE001 — internal layout may move
+        return
+    orig_put = lc.LRUCache.put
+    if getattr(orig_put, "_veles_atomic", False):
+        return
+    cache_suffix = getattr(lc, "_CACHE_SUFFIX", None)
+    atime_suffix = getattr(lc, "_ATIME_SUFFIX", None)
+    if cache_suffix is None:
+        return  # unknown internals: leave jax's behaviour untouched
+
+    import os
+    import time
+
+    @functools.wraps(orig_put)
+    def atomic_put(self, key, val):
+        if getattr(self, "eviction_enabled", True) or not key:
+            return orig_put(self, key, val)  # lock-serialized already
+        final = self.path / f"{key}{cache_suffix}"
+        if final.exists():
+            return
+        tmp = self.path / f"{key}{cache_suffix}.tmp{os.getpid()}"
+        try:
+            tmp.write_bytes(val)
+            os.replace(tmp, final)
+            if atime_suffix is not None:
+                (self.path / f"{key}{atime_suffix}").write_bytes(
+                    time.time_ns().to_bytes(8, "little"))
+        except OSError:
+            # cache is an optimization: a failed write (disk full,
+            # perms) must not fail the compile that produced ``val``
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+
+    atomic_put._veles_atomic = True
+    lc.LRUCache.put = atomic_put
+
+
 def _enable_persistent_compile_cache() -> None:
     """Point XLA at an on-disk executable cache (idempotent).
 
@@ -74,13 +135,13 @@ def _enable_persistent_compile_cache() -> None:
     milliseconds.  Opt out with VELES_TPU_NO_COMPILE_CACHE=1; relocate
     with VELES_TPU_COMPILE_CACHE_DIR.
 
-    The default directory is namespaced by the jaxlib version:
-    deserializing an executable written by a different build (or a
-    torn entry from a process killed mid-write into a shared flat
-    dir) segfaults inside xla_extension — observed on this box as
-    general-protection faults that took out whole pytest runs.  A
-    version-keyed subdir never loads foreign entries and retires any
-    previously corrupted flat dir.
+    The default directory is namespaced by the jaxlib version PLUS an
+    ``aw`` (atomic-writes) era tag: deserializing an executable
+    written by a different build — or a torn entry written before
+    ``_harden_compile_cache_writes`` existed — crashes inside
+    xla_extension, so the namespace retires every directory the old
+    non-atomic writers could have corrupted, exactly like the round-5
+    version-keying retired the flat dir.
     """
     import os
     if os.environ.get("VELES_TPU_NO_COMPILE_CACHE"):
@@ -88,11 +149,12 @@ def _enable_persistent_compile_cache() -> None:
     path = os.environ.get("VELES_TPU_COMPILE_CACHE_DIR")
     try:
         import jax
+        _harden_compile_cache_writes()
         if path is None:
             ver = getattr(jax, "__version__", "unknown")
             path = os.path.join(
                 os.path.expanduser("~"), ".cache", "veles_tpu",
-                f"xla_cache-{ver}")
+                f"xla_cache-{ver}-aw")
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
     except Exception:  # noqa: BLE001 — cache is an optimization only

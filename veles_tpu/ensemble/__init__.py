@@ -12,7 +12,9 @@ from veles_tpu.ensemble.packaging import (load_members,
                                           load_packed_ensemble,
                                           normalize_npz_path,
                                           pack_ensemble, save_members)
+from veles_tpu.ops.fused import EnsembleEvalEngine
 
-__all__ = ["EnsembleTrainer", "EnsemblePredictor", "save_members",
-           "load_members", "pack_ensemble", "load_packed_ensemble",
+__all__ = ["EnsembleTrainer", "EnsemblePredictor",
+           "EnsembleEvalEngine", "save_members", "load_members",
+           "pack_ensemble", "load_packed_ensemble",
            "normalize_npz_path"]

@@ -95,13 +95,29 @@ class EnsembleTrainer(Logger):
 
 class EnsemblePredictor(Logger):
     """Averages member class-probability outputs (the reference's
-    aggregation mode for classifiers)."""
+    aggregation mode for classifiers).
+
+    ``device="auto"`` (the default) serves prediction from the chip
+    whenever the template workflow landed on a jax device: member
+    params are stacked ONCE at construction along a leading member
+    axis and every ``predict_proba``/``error_pct`` call is a single
+    jitted vmapped dispatch with on-device probability averaging
+    (ops/fused.py ``EnsembleEvalEngine``) — N members x L layers of
+    host ``apply_fwd`` calls collapse to one XLA computation.
+    ``device="host"`` forces the numpy member loop, which stays as the
+    engine's parity oracle (and the only path on the numpy backend).
+    """
 
     def __init__(self, workflow_factory: Callable[[], Any],
                  device_factory: Callable[[], Any],
-                 members: List[Dict[str, Any]]) -> None:
+                 members: List[Dict[str, Any]],
+                 device: str = "auto") -> None:
         if not members:
             raise ValueError("empty ensemble")
+        if device not in ("auto", "host"):
+            raise ValueError(f"device={device!r}: use 'auto' (chip "
+                             f"engine when the backend is jax) or "
+                             f"'host' (numpy member loop)")
         self.members = members
         # ONE template workflow provides the pure forward chain; member
         # params are swapped through it
@@ -109,9 +125,27 @@ class EnsemblePredictor(Logger):
         self.workflow = workflow_factory()
         self.workflow.initialize(device=device_factory())
         self._forwards = list(self.workflow.forwards)
+        self.engine = None
+        dev = getattr(self.workflow, "device", None)
+        if device == "auto" and dev is not None and \
+                getattr(dev, "is_jax", False):
+            from veles_tpu.ops.fused import EnsembleEvalEngine
+            self.engine = EnsembleEvalEngine(
+                self._forwards, [m["params"] for m in members], dev)
+            self.info("ensemble predictor: %d members stacked on %r "
+                      "(one vmapped dispatch per batch)",
+                      len(members), dev)
 
     def predict_proba(self, x: np.ndarray) -> np.ndarray:
         """Mean of member probability outputs for a batch (NHWC/ND)."""
+        if self.engine is not None:
+            return self.engine.predict_proba(x)
+        return self.predict_proba_host(x)
+
+    def predict_proba_host(self, x: np.ndarray) -> np.ndarray:
+        """The numpy member-loop oracle: members x layers of eager
+        ``apply_fwd`` host calls.  Kept verbatim as the independent
+        reference the device engine is tested against."""
         acc: Optional[np.ndarray] = None
         for m in self.members:
             out = x
@@ -126,6 +160,16 @@ class EnsemblePredictor(Logger):
     def predict(self, x: np.ndarray) -> np.ndarray:
         return np.argmax(self.predict_proba(x), axis=-1)
 
-    def error_pct(self, x: np.ndarray, labels: np.ndarray) -> float:
-        pred = self.predict(x)
-        return 100.0 * float((pred != labels).mean())
+    def error_pct(self, x: np.ndarray, labels: np.ndarray,
+                  chunk: int = 256) -> float:
+        """Ensemble classification error %, evaluated in fixed-size
+        chunks (one giant batch would materialize every member's
+        full-split activations at once)."""
+        labels = np.asarray(labels)
+        if self.engine is not None:
+            return self.engine.error_pct(x, labels, chunk=chunk)
+        wrong = 0
+        for i in range(0, len(x), chunk):
+            wrong += int((np.argmax(self.predict_proba_host(
+                x[i:i + chunk]), axis=-1) != labels[i:i + chunk]).sum())
+        return 100.0 * wrong / max(len(x), 1)

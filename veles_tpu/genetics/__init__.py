@@ -4,10 +4,12 @@ Reference parity: veles/genetics/ — config values wrapped in
 ``Tune(...)`` become GA genes; the optimizer spawns many workflow runs
 and selects by fitness (validation error) (SURVEY.md §3.1 Genetics).
 
-TPU adaptation: evaluations run in-process sequentially (one chip, jit
-caches warm between runs) instead of forked worker processes; the GA
-itself (tournament selection, blend crossover, gaussian mutation,
-elitism) is deterministic through a named PRNG stream.
+TPU adaptation: the GA itself (tournament selection, blend crossover,
+gaussian mutation, elitism) is deterministic through a named PRNG
+stream; evaluation execution is pluggable — sequential in-process,
+subprocess-per-genome fan-out (worker.py), or the chip-owning
+``tpu-evaluator`` pool (pool.py: ONE persistent evaluator process owns
+the accelerator, prep workers stay host-side, no device race).
 
 Usage::
 
@@ -19,4 +21,14 @@ Usage::
 from veles_tpu.genetics.core import (GeneticOptimizer, Tune, find_tunes,
                                      substitute_tunes)
 
-__all__ = ["Tune", "GeneticOptimizer", "find_tunes", "substitute_tunes"]
+__all__ = ["Tune", "GeneticOptimizer", "find_tunes",
+           "substitute_tunes", "ChipEvaluatorPool"]
+
+
+def __getattr__(name):
+    # pool.py pulls in subprocess machinery; keep `import
+    # veles_tpu.genetics` light for the workers that only need Tune
+    if name == "ChipEvaluatorPool":
+        from veles_tpu.genetics.pool import ChipEvaluatorPool
+        return ChipEvaluatorPool
+    raise AttributeError(name)

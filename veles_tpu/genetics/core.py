@@ -124,6 +124,9 @@ class GeneticOptimizer(Logger):
         self.rng = prng.get(rng_stream).numpy
         #: [(fitness, values)] per generation, best first
         self.history: List[List[Tuple[float, Dict[str, Any]]]] = []
+        #: evaluation throughput accounting (see _fitness_many)
+        self.eval_count = 0
+        self.eval_seconds = 0.0
 
     # -- genome <-> values --------------------------------------------
 
@@ -187,12 +190,32 @@ class GeneticOptimizer(Logger):
             return float("inf")
 
     def _fitness_many(self, genomes: np.ndarray) -> np.ndarray:
+        import time
+        t0 = time.perf_counter()
+        fits = self._fitness_many_inner(genomes)
+        dt = time.perf_counter() - t0
+        #: cumulative (evaluations, seconds) — the GA's own throughput
+        #: record, so execution modes (cpu fan-out vs the chip-owning
+        #: evaluator) are comparable on the same run log
+        self.eval_count += len(genomes)
+        self.eval_seconds += dt
+        if dt > 0:
+            self.info("evaluated %d genomes in %.1fs (%.2f genomes/s)",
+                      len(genomes), dt, len(genomes) / dt)
+        return fits
+
+    def _fitness_many_inner(self, genomes: np.ndarray) -> np.ndarray:
         if self._evaluate_many is None:
             return np.array([self._fitness(g) for g in genomes],
                             np.float64)
         try:
             fits = self._evaluate_many(
                 [self._decode(g) for g in genomes])
+            # batch evaluators (e.g. the chip pool) may mark a lost
+            # genome None — same contract as a failure: inf, selected
+            # against
+            fits = [float("inf") if f is None else float(f)
+                    for f in fits]
             return np.asarray(fits, np.float64)
         except Exception as e:  # noqa: BLE001 — same contract as
             # _fitness: failures score inf, never abort the run

@@ -1,5 +1,7 @@
-"""One-genome evaluation worker (reference parity: veles/genetics/
+"""Genome evaluation workers (reference parity: veles/genetics/
 spawns a process per workflow run — SURVEY.md §3.1 Genetics).
+
+One-shot mode (the classic CPU fan-out unit):
 
 ``python -m veles_tpu.genetics.worker workflow.py [config.py ...]
 --values '<json {path: value}>' [-b BACKEND] [-s SEED]``
@@ -9,6 +11,26 @@ single JSON line ``{"fitness": <best validation error>}`` on stdout.
 The process boundary is the isolation: the global ``root`` mutation,
 jit caches, and any crash stay in this process — the GA parent only
 sees the fitness (or a dead worker, scored inf).
+
+Serve mode (the chip-owning evaluator of the ``tpu-evaluator`` GA
+execution policy — see veles_tpu/genetics/pool.py):
+
+``python -m veles_tpu.genetics.worker --serve workflow.py [...]``
+
+ONE persistent process acquires the device at startup, announces it
+with a hello line (``{"ready": true, "pid", "backend", "platform",
+"is_accelerator"}``), then consumes genome jobs as JSON lines on stdin
+(``{"id": n, "values": {...}, "seed": s}``) and answers each with
+``{"id": n, "fitness": f, "pid": p}`` (or ``{"id", "error"}`` — bad
+genes must never kill the evaluator).  Owning the device across
+genomes is the point: an exclusive TPU admits exactly one client, so
+this is the only process that ever touches it (parallel prep workers
+stay host-side), and the jax client + persistent compile cache stay
+warm between genomes instead of paying process startup + backend init
++ recompile per evaluation.  The per-process ``root`` isolation the
+one-shot mode gets for free is reproduced by snapshotting the pristine
+config tree once and rebuilding it (restore -> config files ->
+overrides -> tunes) before every genome.
 """
 
 from __future__ import annotations
@@ -18,40 +40,143 @@ import json
 import sys
 
 
+def _split_files(files):
+    overrides = [a for a in files if a.startswith("root.") and "=" in a]
+    workflow_file, *config_files = [a for a in files
+                                    if a not in overrides]
+    return workflow_file, config_files, overrides
+
+
+def _evaluate(workflow_file: str, backend: str, seed: int,
+              verbose: bool) -> float:
+    """One genome's training run -> fitness.  ``root`` must already
+    hold the substituted config."""
+    from veles_tpu.launcher import Launcher, drive_workflow, \
+        workflow_fitness
+
+    launcher = Launcher(backend=backend, seed=seed, verbose=verbose)
+    try:
+        drive_workflow(launcher, workflow_file)
+        return workflow_fitness(launcher.workflow)
+    finally:
+        _release(launcher)
+
+
+def _release(launcher) -> None:
+    """Return the device buffers a finished genome run holds — HBM on
+    an exclusive chip must not accumulate across the generations a
+    serve-mode evaluator lives through (same hygiene as bench.py's
+    phase transitions)."""
+    import gc
+    w = getattr(launcher, "workflow", None)
+    if w is not None:
+        fused = getattr(w, "fused", None)
+        if fused is not None and hasattr(fused, "release_device_state"):
+            fused.release_device_state()
+        ld = getattr(w, "loader", None)
+        for vec_name in ("original_data", "original_labels",
+                         "original_targets"):
+            vec = getattr(ld, vec_name, None)
+            if vec is not None and hasattr(vec, "reset"):
+                vec.reset()
+        w.stop()
+    launcher.workflow = None
+    gc.collect()
+
+
+def serve(args) -> int:
+    """The chip-owning evaluation loop (tpu-evaluator mode)."""
+    import copy
+    import os
+
+    from veles_tpu.backends import make_device
+    from veles_tpu.config import parse_overrides, root
+    from veles_tpu.genetics import substitute_tunes
+    from veles_tpu.launcher import apply_config_file
+    from veles_tpu.logger import setup_logging
+
+    setup_logging(10 if args.verbose else 20)
+    workflow_file, config_files, overrides = _split_files(args.files)
+    # the pristine config tree, BEFORE any config file ran: each genome
+    # rebuilds root from here so substitutions can't leak across jobs
+    # (the isolation the one-shot mode's process boundary provided)
+    pristine = copy.deepcopy(dict(root.__dict__))
+
+    # acquire the device ONCE — this process is the chip's only client
+    # for the whole GA run (make_device is memoized, so every genome's
+    # Launcher reuses this same handle)
+    device = make_device(args.backend)
+    platform = getattr(device, "platform", device.backend_name)
+    hello = {"ready": True, "pid": os.getpid(),
+             "backend": device.backend_name, "platform": platform,
+             "is_accelerator": bool(device.is_jax
+                                    and platform != "cpu")}
+    print(json.dumps(hello), flush=True)
+
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        job = json.loads(line)
+        if job.get("op") == "shutdown":
+            break
+        result = {"id": job["id"], "pid": os.getpid()}
+        try:
+            root.__dict__.clear()
+            root.__dict__.update(copy.deepcopy(pristine))
+            for cf in config_files:
+                apply_config_file(cf)
+            parse_overrides(overrides)
+            substitute_tunes(root, job["values"])
+            result["fitness"] = _evaluate(
+                workflow_file, args.backend,
+                int(job.get("seed", args.seed)), args.verbose)
+        except KeyboardInterrupt:
+            raise
+        except BaseException as e:  # noqa: BLE001 — bad genes score
+            # inf at the parent; the evaluator must outlive them
+            result["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(result), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="veles_tpu.genetics.worker")
     p.add_argument("files", nargs="+")
-    p.add_argument("--values", required=True,
-                   help="JSON {tune_path: value}")
+    p.add_argument("--values", default=None,
+                   help="JSON {tune_path: value} (one-shot mode)")
+    p.add_argument("--serve", action="store_true",
+                   help="persistent chip-owning evaluator: genome jobs "
+                        "as JSON lines on stdin, results on stdout")
     p.add_argument("-b", "--backend", default="auto")
     p.add_argument("-s", "--seed", type=int, default=1234)
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
 
+    if args.serve:
+        return serve(args)
+    if args.values is None:
+        p.error("--values is required without --serve")
+
     from veles_tpu.config import parse_overrides, root
     from veles_tpu.genetics import substitute_tunes
-    from veles_tpu.launcher import (Launcher, apply_config_file,
-                                    drive_workflow, workflow_fitness)
 
-    overrides = [a for a in args.files
-                 if a.startswith("root.") and "=" in a]
-    workflow_file, *config_files = [a for a in args.files
-                                    if a not in overrides]
+    workflow_file, config_files, overrides = _split_files(args.files)
+    from veles_tpu.launcher import apply_config_file
     for cf in config_files:
         apply_config_file(cf)
     parse_overrides(overrides)
     substitute_tunes(root, json.loads(args.values))
 
-    launcher = Launcher(backend=args.backend, seed=args.seed,
-                        verbose=args.verbose)
     try:
-        drive_workflow(launcher, workflow_file)
+        fitness = _evaluate(workflow_file, args.backend, args.seed,
+                            args.verbose)
     except RuntimeError as e:
         if "defines neither" in str(e):
             print(str(e), file=sys.stderr)
             return 2
         raise
-    print(json.dumps({"fitness": workflow_fitness(launcher.workflow)}))
+    print(json.dumps({"fitness": fitness}))
     return 0
 
 

@@ -680,3 +680,209 @@ class FusedStepRunner(AcceleratedUnit):
         from collections import deque
         if self.__dict__.get("_inflight") is None:  # dropped by pickle
             self._inflight = deque()
+
+
+class EnsembleEvalEngine:
+    """Device-resident multi-member fused inference.
+
+    The host predictor (ensemble/core.py) iterates members x layers
+    calling ``apply_fwd`` with numpy arrays — N x L Python dispatches
+    per batch, bypassing the execution engine entirely.  This engine
+    stacks every member's param pytree along a leading MEMBER axis,
+    ``jax.vmap``s the same pure forward chain over that axis, and
+    averages the member probability outputs ON DEVICE, so an N-member
+    ensemble prediction is ONE jitted dispatch.  Matmuls/convs run in
+    the device's compute dtype (bf16 on TPU) against the f32 stacked
+    params, exactly like the fused eval step; probabilities accumulate
+    in f32.
+
+    Two data paths, mirroring the training engine's residency split:
+
+    - **streaming**: :meth:`predict_proba` / :meth:`error_pct` upload
+      each host batch through ``device.put`` (so ``Device.h2d_bytes``
+      accounting stays live) and dispatch once per batch;
+    - **resident**: :meth:`attach_dataset` uploads the split ONCE; the
+      ``*_resident`` methods then gather minibatch rows from HBM by
+      index — repeated evaluation (GA scoring, sweeps) never re-ships
+      pixels.
+
+    Error scoring accumulates ``[n_wrong, count]`` in a donated device
+    carry across fixed-shape chunks (one compile, no retraces from a
+    ragged tail — the tail is mask-padded), and the host fetches 8
+    bytes at the end.
+    """
+
+    def __init__(self, forwards: List[Any],
+                 member_params: List[Dict[str, Dict[str, Any]]],
+                 device: Any, compute_dtype: Any = None) -> None:
+        if not member_params:
+            raise ValueError("empty ensemble")
+        if device is None or not getattr(device, "is_jax", False):
+            raise ValueError(
+                "EnsembleEvalEngine needs a jax device (TPU or "
+                "XLA:CPU); use the host predictor path on numpy")
+        self.forwards = list(forwards)
+        self.device = device
+        self.n_members = len(member_params)
+        self.compute_dtype = compute_dtype
+        #: stacked params: {fwd_name: {pname: (n_members, ...)}} in HBM
+        self._params = {
+            f.name: {
+                pn: device.put(np.stack(
+                    [np.asarray(m[f.name][pn], np.float32)
+                     for m in member_params]))
+                for pn in member_params[0][f.name]}
+            for f in self.forwards}
+        self._dataset = None
+        self._labels = None
+        self._predict = None
+        self._score = None
+        self._predict_resident = None
+        self._score_resident = None
+        self._build()
+
+    def _resolved_dtype(self):
+        import jax.numpy as jnp
+        cd = self.compute_dtype
+        if cd is None:
+            cd = self.device.compute_dtype
+        return jnp.dtype(cd) if cd is not None else jnp.float32
+
+    def _build(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        forwards = self.forwards
+        cd = self._resolved_dtype()
+        mixed = cd != jnp.float32
+
+        def cast(tree):
+            if not mixed:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(cd) if a.dtype == jnp.float32 else a,
+                tree)
+
+        def member_forward(params, x):
+            # ONE member's pure inference chain — the same apply_fwd
+            # path the fused eval step traces; vmap lifts it over the
+            # stacked member axis of ``params`` with x broadcast
+            if mixed:
+                x = x.astype(cd)
+            for f in forwards:
+                x, _ = f.apply_fwd(params[f.name], x, rng=None,
+                                   train=False)
+            return x.astype(jnp.float32)
+
+        def mean_probs(params, x):
+            probs = jax.vmap(member_forward, in_axes=(0, None))(
+                cast(params), x)
+            return jnp.mean(probs, axis=0)
+
+        def score(params, acc, x, labels, mask):
+            p = mean_probs(params, x)
+            pred = jnp.argmax(p, axis=-1)
+            wrong = jnp.sum((pred != labels).astype(jnp.float32) * mask)
+            return acc + jnp.stack([wrong, jnp.sum(mask)])
+
+        def predict_resident(params, dataset, indices):
+            return mean_probs(params, jnp.take(dataset, indices,
+                                               axis=0))
+
+        def score_resident(params, acc, dataset, label_store, indices,
+                           mask):
+            x = jnp.take(dataset, indices, axis=0)
+            labels = jnp.take(label_store, indices, axis=0)
+            return score(params, acc, x, labels, mask)
+
+        self._predict = jax.jit(mean_probs)
+        self._score = jax.jit(score, donate_argnums=(1,))
+        self._predict_resident = jax.jit(predict_resident)
+        self._score_resident = jax.jit(score_resident,
+                                       donate_argnums=(1,))
+
+    # -- streaming path ------------------------------------------------
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        """Mean member probabilities for a host batch — one vmapped
+        dispatch (a distinct batch shape compiles once)."""
+        xb = self.device.put(np.asarray(x, np.float32))
+        return np.asarray(self._predict(self._params, xb))
+
+    def error_pct(self, x: np.ndarray, labels: np.ndarray,
+                  chunk: int = 256) -> float:
+        """Classification error % of the averaged ensemble over a host
+        split, chunked at a fixed shape with a donated [wrong, count]
+        device carry."""
+        x = np.asarray(x, np.float32)
+        labels = np.asarray(labels, np.int32)
+        chunk = max(1, min(chunk, len(x)))
+        acc = self.device.zeros(2, np.float32)
+        for i in range(0, len(x), chunk):
+            xb, lb, mask = _pad_chunk(x[i:i + chunk],
+                                      labels[i:i + chunk], chunk)
+            acc = self._score(self._params, acc, self.device.put(xb),
+                              self.device.put(lb),
+                              self.device.put(mask))
+        acc = np.asarray(acc)
+        return 100.0 * float(acc[0]) / max(float(acc[1]), 1.0)
+
+    # -- resident path -------------------------------------------------
+
+    def attach_dataset(self, x: np.ndarray,
+                       labels: Optional[np.ndarray] = None) -> None:
+        """Upload an evaluation split ONCE; the ``*_resident`` methods
+        gather rows from HBM by index afterwards."""
+        self._dataset = self.device.put(np.asarray(x, np.float32))
+        self._labels = None if labels is None else \
+            self.device.put(np.asarray(labels, np.int32))
+
+    def predict_proba_resident(self, indices) -> np.ndarray:
+        if self._dataset is None:
+            raise RuntimeError("attach_dataset() first")
+        idx = self.device.put(np.asarray(indices, np.int32))
+        return np.asarray(self._predict_resident(
+            self._params, self._dataset, idx))
+
+    def error_pct_resident(self, n: Optional[int] = None,
+                           chunk: int = 256) -> float:
+        """Error % over the first ``n`` attached rows (default: all),
+        gathered on device — zero pixel re-upload per call."""
+        if self._dataset is None or self._labels is None:
+            raise RuntimeError("attach_dataset(x, labels) first")
+        total = int(self._dataset.shape[0]) if n is None else int(n)
+        chunk = max(1, min(chunk, total))
+        acc = self.device.zeros(2, np.float32)
+        for i in range(0, total, chunk):
+            idx = np.arange(i, min(i + chunk, total), dtype=np.int32)
+            mask = np.ones(chunk, np.float32)
+            if len(idx) < chunk:
+                mask[len(idx):] = 0.0
+                idx = np.pad(idx, (0, chunk - len(idx)))
+            acc = self._score_resident(
+                self._params, acc, self._dataset, self._labels,
+                self.device.put(idx), self.device.put(mask))
+        acc = np.asarray(acc)
+        return 100.0 * float(acc[0]) / max(float(acc[1]), 1.0)
+
+    def release(self) -> None:
+        """Drop every device buffer (stacked params + attached split)
+        — same hygiene contract as release_device_state above."""
+        self._params = None
+        self._dataset = None
+        self._labels = None
+        self._predict = self._score = None
+        self._predict_resident = self._score_resident = None
+
+
+def _pad_chunk(xb: np.ndarray, lb: np.ndarray, chunk: int):
+    """Fixed-shape chunk + validity mask: the scoring jit compiles
+    exactly once; padded rows carry mask 0 and cannot score."""
+    mask = np.ones(chunk, np.float32)
+    if len(xb) < chunk:
+        pad = chunk - len(xb)
+        mask[len(xb):] = 0.0
+        xb = np.concatenate(
+            [xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+        lb = np.concatenate([lb, np.zeros(pad, lb.dtype)])
+    return xb, lb, mask
