@@ -1,0 +1,263 @@
+"""Population-batched GA training (ISSUE 4 tentpole): same-signature
+genome cohorts train as ONE vmapped fused dispatch chain
+(ops/fused.py PopulationTrainEngine), bucketed by shape signature in
+GeneticOptimizer._fitness_many and dispatched through the chip-owning
+evaluator's cohort jobs (genetics/worker.py --serve + pool.py
+evaluate_cohort).  The per-genome path is the parity ORACLE: batched
+fitnesses must match it to f32 tolerance."""
+
+import json
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.genetics import (GeneticOptimizer, Tune, liftable_tune,
+                                shape_signature)
+
+LR = "wine.layers[0]['<-']['learning_rate']"
+WIDTH = "wine.layers[0]['->']['output_sample_shape']"
+
+
+class TestLiftableSignature:
+    def test_float_lr_and_wd_are_liftable(self):
+        assert liftable_tune("m.layers[0]['<-']['learning_rate']",
+                             Tune(0.1, 0.01, 1.0))
+        assert liftable_tune("m.layers[2]['<-']['weight_decay']",
+                             Tune(0.001, 0.0, 0.1))
+        assert liftable_tune("m.layers[1]['<-']['learning_rate_bias']",
+                             Tune(0.1, 0.01, 1.0))
+
+    def test_int_and_structural_tunes_are_not(self):
+        # an integer gene always changes shapes — never liftable, even
+        # on a learning_rate-looking path
+        assert not liftable_tune("m.layers[0]['<-']['learning_rate']",
+                                 Tune(1, 1, 8))
+        assert not liftable_tune(
+            "m.layers[0]['->']['output_sample_shape']", Tune(16, 8, 32))
+        assert not liftable_tune("m.loader['minibatch_size']",
+                                 Tune(32.0, 8.0, 64.0))
+
+    def test_signature_keys_only_non_liftable(self):
+        tunes = {WIDTH: Tune(16, 8, 32), LR: Tune(0.1, 0.01, 1.0)}
+        a = shape_signature({WIDTH: 16, LR: 0.3}, tunes)
+        b = shape_signature({WIDTH: 16, LR: 0.9}, tunes)
+        c = shape_signature({WIDTH: 24, LR: 0.3}, tunes)
+        assert a == b           # lr does not split cohorts
+        assert a != c           # width does
+
+
+class TestCohortBucketing:
+    """_fitness_many buckets by signature and dispatches one cohort
+    per bucket; decode failures score inf without poisoning their
+    bucket; a failing bucket falls back to the per-genome oracle."""
+
+    # lr range < 10x so the gene stays linear (log-scale genes would
+    # decode these hand-written genomes through exp)
+    TUNES = {WIDTH: Tune(16, 8, 32), LR: Tune(0.5, 0.2, 1.0)}
+
+    def fitness_of(self, values):
+        return values[WIDTH] + values[LR]
+
+    def test_buckets_by_signature_singletons_included(self):
+        calls = []
+
+        def cohort(values_list):
+            calls.append([v[WIDTH] for v in values_list])
+            return [self.fitness_of(v) for v in values_list]
+
+        opt = GeneticOptimizer(self.fitness_of, self.TUNES,
+                               population=4, generations=1,
+                               evaluate_cohort=cohort)
+        genomes = np.asarray([
+            [16.0, 0.3], [16.0, 0.9], [24.0, 0.5], [16.0, 0.25]])
+        # "['->']" sorts before "['<-']" -> gene order (width, lr)
+        assert opt.paths == [WIDTH, LR]
+        fits = opt._fitness_many(genomes)
+        expect = [16.3, 16.9, 24.5, 16.25]
+        assert np.allclose(fits, expect)
+        sizes = sorted(len(c) for c in calls)
+        assert sizes == [1, 3]          # one cohort + one singleton
+        assert sorted(opt.last_cohort_sizes) == [1, 3]
+
+    def test_decode_failure_scores_inf_without_poisoning(self):
+        class BoomTune(Tune):
+            def clip(self, x):
+                if x > 20:
+                    raise ValueError("boom")
+                return super().clip(x)
+
+        tunes = {WIDTH: BoomTune(16, 8, 32), LR: Tune(0.5, 0.2, 1.0)}
+        seen = []
+
+        def cohort(values_list):
+            seen.extend(v[WIDTH] for v in values_list)
+            return [1.0 for _ in values_list]
+
+        opt = GeneticOptimizer(self.fitness_of, tunes, population=3,
+                               generations=1, evaluate_cohort=cohort)
+        fits = opt._fitness_many(np.asarray(
+            [[16.0, 0.3], [28.0, 0.3], [16.0, 0.5]]))
+        assert fits[1] == float("inf")      # decode raised
+        assert fits[0] == 1.0 and fits[2] == 1.0
+        assert seen == [16, 16]             # bad genome never shipped
+
+    def test_failed_bucket_falls_back_to_oracle(self):
+        def cohort(values_list):
+            raise RuntimeError("cohort path down")
+
+        opt = GeneticOptimizer(self.fitness_of, self.TUNES,
+                               population=2, generations=1,
+                               evaluate_cohort=cohort)
+        fits = opt._fitness_many(np.asarray([[16.0, 0.3], [16.0, 0.9]]))
+        assert np.allclose(fits, [16.3, 16.9])  # oracle answered
+
+
+class TestEngineParity:
+    """The vmapped engine against per-genome full workflow runs,
+    in-process — the core parity pin (float-tune cohort, shared init,
+    per-member lr/wd, early-stop bookkeeping)."""
+
+    def build(self, lr, wd=0.001, epochs=5, fail=100):
+        from veles_tpu.backends import JaxDevice
+        from veles_tpu.models import wine
+
+        class FL:
+            workflow = None
+
+        prng._streams.clear()
+        prng.seed_all(1234)
+        layers = [
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": lr, "weight_decay": wd,
+                    "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": lr, "gradient_moment": 0.9}},
+        ]
+        w = wine.create_workflow(
+            FL(), layers=layers,
+            decision={"max_epochs": epochs, "fail_iterations": fail})
+        w.initialize(device=JaxDevice(platform="cpu"))
+        return w
+
+    def test_cohort_matches_per_genome_oracle(self):
+        from veles_tpu.launcher import workflow_fitness
+        from veles_tpu.ops.fused import PopulationTrainEngine
+
+        lrs = [0.3, 0.05, 0.8]
+        oracle = []
+        for lr in lrs:
+            w = self.build(lr, fail=1)   # small fail_iterations: some
+            w.run()                      # members stop early
+            oracle.append(workflow_fitness(w))
+            w.stop()
+
+        w = self.build(lrs[0], fail=1)
+        rates = np.asarray([[[lr, lr], [lr, lr]] for lr in lrs],
+                           np.float32)
+        decays = np.asarray([[[0.001, 0.0], [0.0, 0.0]]] * len(lrs),
+                            np.float32)
+        engine = PopulationTrainEngine(w, rates, decays)
+        fits = engine.run()
+        engine.release()
+        w.stop()
+        assert np.allclose(fits, oracle, atol=1e-3), (fits, oracle)
+
+    def test_streaming_loader_rejected(self):
+        """Streaming datasets fall back to per-genome — the engine
+        must refuse them loudly, not train garbage."""
+        from veles_tpu.ops.fused import PopulationTrainEngine
+
+        w = self.build(0.3)
+        w.fused.streaming = True
+        with pytest.raises(ValueError, match="resident"):
+            PopulationTrainEngine(
+                w, np.zeros((2, 2, 2), np.float32),
+                np.zeros((2, 2, 2), np.float32))
+        w.stop()
+
+
+@pytest.fixture
+def cohort_workflow(tmp_path):
+    wf = tmp_path / "wf.py"
+    wf.write_text(textwrap.dedent("""
+        from veles_tpu.models import wine
+
+        def create_workflow(launcher):
+            return wine.create_workflow(launcher)
+
+        def run(launcher):
+            launcher.create_workflow(create_workflow)
+            launcher.initialize()
+            launcher.run()
+    """))
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text(textwrap.dedent("""
+        from veles_tpu.config import root
+        from veles_tpu.genetics import Tune
+
+        root.wine.decision = {"max_epochs": 3}
+        root.wine.layers = [
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": Tune(8, 4, 16)},
+             "<-": {"learning_rate": Tune(0.3, 0.01, 1.0)}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.3}},
+        ]
+    """))
+    return str(wf), str(cfg)
+
+
+class TestPoolCohortParity:
+    """End to end through the serve-mode evaluator: batched-cohort
+    fitnesses == the per-genome oracle (mixed signatures, a singleton
+    bucket, and a structurally-bad member that scores inf without
+    poisoning its cohort)."""
+
+    def serve_cmd(self, wf, cfg):
+        return [sys.executable, "-m", "veles_tpu.genetics.worker",
+                "--serve", wf, cfg, "-b", "cpu", "-s", "1234"]
+
+    def test_cohort_matches_oracle_and_isolates_bad_member(
+            self, cohort_workflow):
+        from veles_tpu.genetics.pool import ChipEvaluatorPool
+        wf, cfg = cohort_workflow
+        cohort = [{WIDTH: 8, LR: 0.3}, {WIDTH: 8, LR: 0.05}]
+        singleton = [{WIDTH: 12, LR: 0.3}]
+        with ChipEvaluatorPool(self.serve_cmd(wf, cfg), workers=2,
+                               timeout=300) as pool:
+            oracle = pool.evaluate_many(cohort + singleton)
+            batched = pool.evaluate_cohort(cohort)
+            batched += pool.evaluate_cohort(singleton)
+            assert all(np.isfinite(f) for f in oracle), oracle
+            assert np.allclose(batched, oracle, atol=1e-3), \
+                (batched, oracle)
+            # a member whose decode produces a DIFFERENT structure
+            # scores inf; the rest of the cohort still matches the
+            # oracle (no poisoning, evaluator survives)
+            mixed = pool.evaluate_cohort(
+                [cohort[0], {WIDTH: -5, LR: 0.1}, cohort[1]])
+            assert mixed[1] == float("inf")
+            assert np.allclose([mixed[0], mixed[2]], oracle[:2],
+                               atol=1e-3)
+
+    def test_cli_ga_cohort_end_to_end(self, cohort_workflow):
+        """`python -m veles_tpu -b tpu-evaluator --optimize` with
+        cohort batching on: mixed-signature generations bucket and
+        complete with finite best fitness."""
+        import subprocess
+
+        import os
+        wf, cfg = cohort_workflow
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        res = subprocess.run(
+            [sys.executable, "-m", "veles_tpu", "-b", "tpu-evaluator",
+             "--optimize", "4:1", "--ga-workers", "2", wf, cfg],
+            capture_output=True, text=True, cwd=repo, timeout=600)
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert "cohorts:" in res.stderr      # the batched path ran
+        out = json.loads(res.stdout.strip().splitlines()[-1])
+        assert np.isfinite(out["fitness"])

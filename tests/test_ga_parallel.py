@@ -239,6 +239,63 @@ class TestChipEvaluatorPool:
         assert "--optimize" in res.stderr
 
 
+DYING_WORKER = """
+import json, os, sys
+
+sentinel = sys.argv[1]
+print(json.dumps({"ready": True, "pid": os.getpid(),
+                  "backend": "cpu", "platform": "cpu",
+                  "is_accelerator": False}), flush=True)
+for line in sys.stdin:
+    job = json.loads(line)
+    if job.get("op") == "shutdown":
+        break
+    if not os.path.exists(sentinel):
+        # first delivery EVER: die mid-genome (simulates an
+        # evaluator-side crash that is not the genome's fault)
+        open(sentinel, "w").close()
+        os._exit(1)
+    print(json.dumps({"id": job["id"],
+                      "fitness": float(job["values"]["x"])}),
+          flush=True)
+"""
+
+
+class TestEvaluatorDeathRetry:
+    """An evaluator-side death must not condemn the in-flight genome:
+    it is retried ONCE on the fresh evaluator before scoring inf."""
+
+    def make_pool(self, tmp_path, timeout=60):
+        from veles_tpu.genetics.pool import ChipEvaluatorPool
+        worker = tmp_path / "dying_worker.py"
+        worker.write_text(DYING_WORKER)
+        sentinel = tmp_path / "died_once"
+        return ChipEvaluatorPool(
+            [sys.executable, str(worker), str(sentinel)],
+            workers=2, timeout=timeout)
+
+    def test_in_flight_genome_retried_once_then_scores(self, tmp_path):
+        with self.make_pool(tmp_path) as pool:
+            first_pid = pool.hello["pid"]
+            fits = pool.evaluate_many([{"x": 1.5}, {"x": 2.5}])
+            # the worker died on genome 1's first delivery; the retry
+            # on the fresh evaluator succeeded — NO unfair inf
+            assert fits == [1.5, 2.5]
+            assert pool.hello["pid"] != first_pid   # restarted
+
+    def test_twice_lost_genome_scores_inf(self, tmp_path):
+        with self.make_pool(tmp_path) as pool:
+            # poison pill: the worker dies whenever x is the string
+            # "die" (float() raises -> worker crashes uncleanly)
+            import os
+            sentinel = tmp_path / "died_once"
+            open(sentinel, "w").close()   # skip the one-time death
+            assert os.path.exists(sentinel)
+            fits = pool.evaluate_many([{"x": "die"}, {"x": 3.5}])
+            assert fits[0] == float("inf")   # lost twice -> inf
+            assert fits[1] == 3.5            # queue kept draining
+
+
 class TestSubprocessGA:
     def test_worker_evaluates_one_genome(self, tuned_workflow):
         wf, cfg = tuned_workflow

@@ -1,0 +1,139 @@
+"""One-pipeline real-data rehearsal (round-5 VERDICT missing #4): an
+on-disk image tree -> the ``prepare-imagenet`` CLI -> the streaming
+image loader -> the fused train step, end to end in one test.  Bounded
+CPU-tier variant; tests_tpu/ carries the chip-tier twin.
+
+What it pins that the per-piece tests cannot: the prepared tree's
+layout is what ImageDirectoryLoader expects, streaming mode decodes on
+the prefetch path, the fused step consumes host-assembled superstep
+batches, and the wire accounting (``stream_transfer_bytes``) sees real
+pixels move."""
+
+import os
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.datasets import _main as datasets_cli
+
+
+def write_png(path, arr):
+    from PIL import Image
+    Image.fromarray(arr.astype(np.uint8)).save(path)
+
+
+def make_tree(base, n_classes=2, per_class=12, size=24):
+    rng = np.random.default_rng(17)
+    for c in range(n_classes):
+        d = os.path.join(base, f"cls_{c}")
+        os.makedirs(d)
+        for i in range(per_class):
+            # class-dependent mean so a couple of supersteps of
+            # training have signal to reduce
+            arr = rng.integers(0, 120, (size, size, 3)) + 100 * c
+            write_png(os.path.join(d, f"im{i:02d}.png"),
+                      np.clip(arr, 0, 255))
+
+
+def build_streaming_workflow(prepared, image_size=20, mb=6):
+    from veles_tpu.loader.image import ImageDirectoryLoader
+    from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+    prng.seed_all(1234)
+    return StandardWorkflow(
+        loader_factory=lambda wf: ImageDirectoryLoader(
+            wf, name="loader", data_dir=prepared,
+            target_shape=(image_size, image_size, 3),
+            minibatch_size=mb, streaming=True),
+        layers=[
+            {"type": "conv_relu",
+             "->": {"n_kernels": 4, "kx": 5, "ky": 5, "sliding": 2},
+             "<-": {"learning_rate": 0.02}},
+            {"type": "max_pooling", "->": {"kx": 2, "ky": 2},
+             "<-": {}},
+            {"type": "softmax", "->": {"output_sample_shape": 2},
+             "<-": {"learning_rate": 0.02}},
+        ],
+        loss_function="softmax",
+        decision_config={"max_epochs": 2},
+        superstep=2,
+        name="RehearsalWorkflow")
+
+
+def test_prepare_then_stream_train(tmp_path, capsys):
+    from veles_tpu.backends import JaxDevice
+
+    src = tmp_path / "src"
+    os.makedirs(src)
+    make_tree(str(src))
+    prepared = tmp_path / "prepared"
+    # the REAL CLI surface, not the library function
+    rc = datasets_cli(["prepare-imagenet", str(src),
+                       "--out", str(prepared), "--image-size", "20",
+                       "--valid-frac", "0.25"])
+    assert rc == 0
+    assert (prepared / "labels.json").exists()
+
+    w = build_streaming_workflow(str(prepared))
+    w.initialize(device=JaxDevice(platform="cpu"))
+    # the tiny tree must actually have fallen off the resident path —
+    # otherwise this rehearses the wrong pipeline
+    assert w.fused.streaming
+    assert not w.loader.device_resident
+    w.run()
+    w.stop()
+
+    # a few streaming supersteps trained: finite loss every epoch ...
+    hist = w.decision.history
+    assert len(hist) == 4          # 2 epochs x (validation + train)
+    for h in hist:
+        assert np.isfinite(h["loss"]), hist
+    # ... and real bytes moved over the (virtual) wire, consistent
+    # with >= the train split's pixels for the epochs run
+    assert w.fused.stream_transfer_bytes > 0
+    one_image = 20 * 20 * 3 * 4    # f32 pixels
+    assert w.fused.stream_transfer_bytes >= one_image * 18  # 9/epoch
+
+
+def test_streaming_equals_resident_first_epoch(tmp_path):
+    """The rehearsal's streaming trajectory is not a new numerics
+    path: the same prepared tree trained resident (streaming=False)
+    produces the same first-epoch metrics."""
+    from veles_tpu.backends import JaxDevice
+    from veles_tpu.datasets import prepare_imagenet
+    from veles_tpu.loader.image import ImageDirectoryLoader
+    from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+    src = tmp_path / "src"
+    os.makedirs(src)
+    make_tree(str(src))
+    prepared = str(tmp_path / "prepared")
+    prepare_imagenet(str(src), prepared, image_size=20,
+                     valid_frac=0.25, progress_every=0)
+
+    def run_one(streaming):
+        prng._streams.clear()
+        prng.seed_all(1234)
+        w = StandardWorkflow(
+            loader_factory=lambda wf: ImageDirectoryLoader(
+                wf, name="loader", data_dir=prepared,
+                target_shape=(20, 20, 3), minibatch_size=6,
+                streaming=streaming),
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.05}},
+                {"type": "softmax", "->": {"output_sample_shape": 2},
+                 "<-": {"learning_rate": 0.05}},
+            ],
+            loss_function="softmax",
+            decision_config={"max_epochs": 1},
+            superstep=2, name="RehearsalParity")
+        w.initialize(device=JaxDevice(platform="cpu"))
+        w.run()
+        w.stop()
+        return [(h["class"], h["n_err"], round(h["loss"], 5))
+                for h in w.decision.history]
+
+    assert run_one(True) == run_one(False)

@@ -431,6 +431,109 @@ class TestChipEvaluatorGA:
             pool.close()
 
 
+class TestPopulationTrainOnChip:
+    def test_cohort_engine_matches_oracle_at_bf16(self, tpu_device):
+        """ISSUE 4 tentpole on the real chip: a float-tune cohort
+        trained as ONE vmapped dispatch chain lands within a few
+        validation errors of the per-genome oracle (bf16 compute puts
+        counts, not exact equality, in reach on chip)."""
+        from veles_tpu.launcher import workflow_fitness
+        from veles_tpu.models import wine
+        from veles_tpu.ops.fused import PopulationTrainEngine
+
+        class FL:
+            workflow = None
+
+        def build(lr):
+            prng._streams.clear()
+            prng.seed_all(1234)
+            layers = [
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": lr}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": lr}},
+            ]
+            w = wine.create_workflow(FL(), layers=layers,
+                                     decision={"max_epochs": 4})
+            w.initialize(device=tpu_device)
+            return w
+
+        lrs = [0.3, 0.05]
+        oracle = []
+        for lr in lrs:
+            w = build(lr)
+            w.run()
+            oracle.append(workflow_fitness(w))
+            w.stop()
+        w = build(lrs[0])
+        rates = np.asarray([[[lr, lr], [lr, lr]] for lr in lrs],
+                           np.float32)
+        engine = PopulationTrainEngine(
+            w, rates, np.zeros_like(rates))
+        fits = engine.run()
+        engine.release()
+        w.stop()
+        assert np.all(np.isfinite(fits)), fits
+        assert np.allclose(fits, oracle, atol=3.0), (fits, oracle)
+
+
+class TestImagePipelineOnChip:
+    def test_prepared_tree_streams_through_fused_step(self, tpu_device,
+                                                      tmp_path):
+        """Chip-tier twin of tests/test_pipeline_rehearsal.py: an
+        on-disk image tree through prepare_imagenet -> streaming
+        ImageDirectoryLoader -> the fused step on the REAL chip, with
+        live transfer accounting."""
+        import os
+
+        from PIL import Image
+
+        from veles_tpu.datasets import prepare_imagenet
+        from veles_tpu.loader.image import ImageDirectoryLoader
+
+        rng = np.random.default_rng(17)
+        src = tmp_path / "src"
+        for c in range(2):
+            d = src / f"cls_{c}"
+            os.makedirs(d)
+            for i in range(12):
+                arr = np.clip(rng.integers(0, 120, (24, 24, 3))
+                              + 100 * c, 0, 255)
+                Image.fromarray(arr.astype(np.uint8)).save(
+                    d / f"im{i:02d}.png")
+        prepared = str(tmp_path / "prepared")
+        prepare_imagenet(str(src), prepared, image_size=20,
+                         valid_frac=0.25, progress_every=0)
+
+        prng.seed_all(1234)
+        w = StandardWorkflow(
+            loader_factory=lambda wf: ImageDirectoryLoader(
+                wf, name="loader", data_dir=prepared,
+                target_shape=(20, 20, 3), minibatch_size=6,
+                streaming=True),
+            layers=[
+                {"type": "conv_relu",
+                 "->": {"n_kernels": 4, "kx": 5, "ky": 5,
+                        "sliding": 2},
+                 "<-": {"learning_rate": 0.02}},
+                {"type": "max_pooling", "->": {"kx": 2, "ky": 2},
+                 "<-": {}},
+                {"type": "softmax", "->": {"output_sample_shape": 2},
+                 "<-": {"learning_rate": 0.02}},
+            ],
+            loss_function="softmax",
+            decision_config={"max_epochs": 2},
+            superstep=2, name="ChipRehearsal")
+        w.initialize(device=tpu_device)
+        assert w.fused.streaming
+        w.run()
+        w.stop()
+        for h in w.decision.history:
+            assert np.isfinite(h["loss"]), w.decision.history
+        assert w.fused.stream_transfer_bytes > 0
+
+
 class TestStreamingAccountingOnChip:
     def test_streaming_trains_and_accounts_transfers(self, tpu_device):
         """The streaming path on the real chip (the benchmark's

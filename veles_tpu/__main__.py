@@ -66,6 +66,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ga-eval-timeout", type=float, default=3600,
                    help="seconds before a genome's training run is "
                         "killed and scored inf (default 3600)")
+    p.add_argument("--ga-cohort", type=int, default=0,
+                   help="tpu-evaluator mode: genomes sharing a shape "
+                        "signature (identical integer tunes) train as "
+                        "ONE population-batched vmapped dispatch of up "
+                        "to this many members (0 = auto, capped by the "
+                        "HBM budget; 1 = disable cohort batching and "
+                        "evaluate per genome)")
     p.add_argument("--ga-state", default=None, metavar="FILE",
                    help="per-generation GA checkpoint; an existing "
                         "file resumes the run")
@@ -191,9 +198,12 @@ def _resolve_ga_execution(backend: str, workers: int):
     - ``auto`` -> ``tpu-evaluator`` mode: ONE evaluator subprocess owns
       the device (TPU when present) and executes every genome on it;
       the N workers become host-side prep threads that never construct
-      a device, so there is no race by construction.  When the
-      evaluator's hello reports no accelerator, run_optimizer falls
-      back to the classic ``cpu`` subprocess fan-out;
+      a device, so there is no race by construction.  Runs routed here
+      are also eligible for POPULATION-BATCHED evaluation: genomes
+      sharing a shape signature train as one vmapped cohort dispatch
+      (``--ga-cohort``; run_optimizer wires evaluate_cohort).  When
+      the evaluator's hello reports no accelerator, run_optimizer
+      falls back to the classic ``cpu`` subprocess fan-out;
     - explicit ``tpu-evaluator`` -> the same, honored even without an
       accelerator (the evaluator then runs genomes on XLA:CPU,
       still one process, compile caches warm across genomes);
@@ -357,7 +367,8 @@ def run_optimizer(args, workflow_file: str, config_files, overrides) \
         serve_cmd = [sys.executable, "-m",
                      "veles_tpu.genetics.worker", "--serve",
                      workflow_file, *config_files, *overrides,
-                     "-b", "auto", "-s", str(args.seed)]
+                     "-b", "auto", "-s", str(args.seed),
+                     "--cohort", str(max(0, args.ga_cohort))]
         if args.verbose:
             serve_cmd.append("-v")
         pool = ChipEvaluatorPool(serve_cmd, workers=workers,
@@ -423,10 +434,19 @@ def run_optimizer(args, workflow_file: str, config_files, overrides) \
         evaluate_one, evaluate_many = evaluate_one_subprocess, \
             evaluate_many_subprocess
 
+    # population-batched cohorts ride the chip-owning evaluator: the
+    # optimizer buckets each generation by shape signature and the
+    # evaluator trains every bucket as one vmapped dispatch chain
+    # (--ga-cohort 1 opts out; any failure falls back to the
+    # per-genome oracle inside _fitness_many)
+    evaluate_cohort = pool.evaluate_cohort \
+        if pool is not None and args.ga_cohort != 1 else None
+
     try:
         opt = GeneticOptimizer(evaluate_one, tunes, population=pop,
                                generations=gen,
                                evaluate_many=evaluate_many,
+                               evaluate_cohort=evaluate_cohort,
                                state_path=args.ga_state)
         best, fitness = opt.run()
     finally:

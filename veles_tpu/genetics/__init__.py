@@ -19,10 +19,12 @@ Usage::
 """
 
 from veles_tpu.genetics.core import (GeneticOptimizer, Tune, find_tunes,
+                                     liftable_tune, shape_signature,
                                      substitute_tunes)
 
 __all__ = ["Tune", "GeneticOptimizer", "find_tunes",
-           "substitute_tunes", "ChipEvaluatorPool"]
+           "substitute_tunes", "liftable_tune", "shape_signature",
+           "ChipEvaluatorPool"]
 
 
 def __getattr__(name):

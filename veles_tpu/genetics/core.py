@@ -85,6 +85,34 @@ def substitute_tunes(tree: Any, values: Dict[str, Any]) -> None:
         _set_path(tree, path, v)
 
 
+#: gradient-unit hyperparameters a float gene may set WITHOUT changing
+#: any array shape — the population-batched trainer lifts these into
+#: per-member vectors (``lr_rates`` / ``update_params(decays=...)``),
+#: so genomes differing only here share one vmapped training dispatch
+LIFTABLE_HYPERS = ("learning_rate", "learning_rate_bias",
+                   "weight_decay", "weight_decay_bias")
+
+
+def liftable_tune(path: str, tune: Tune) -> bool:
+    """True when this gene only scales a per-member SGD hyperparameter
+    (float learning rate / weight decay in a layer's ``"<-"`` dict).
+    Integer genes (layer widths, kernel counts) always change the
+    decoded model's SHAPE SIGNATURE and split cohorts instead."""
+    if tune.is_int:
+        return False
+    return any(path.endswith(f"[{k!r}]") for k in LIFTABLE_HYPERS)
+
+
+def shape_signature(values: Dict[str, Any],
+                    tunes: Dict[str, Tune]) -> Tuple:
+    """The cohort key of a decoded genome: every NON-liftable decoded
+    value, in path order.  Two genomes with equal signatures decode to
+    identical model structure/data/optimizer topology and may train as
+    members of one vmapped cohort."""
+    return tuple((p, values[p]) for p in sorted(tunes)
+                 if not liftable_tune(p, tunes[p]))
+
+
 class GeneticOptimizer(Logger):
     """Tournament-select / blend-crossover / gaussian-mutate GA.
 
@@ -103,6 +131,8 @@ class GeneticOptimizer(Logger):
                  rng_stream: str = "genetics",
                  evaluate_many: Optional[Callable[
                      [List[Dict[str, Any]]], List[float]]] = None,
+                 evaluate_cohort: Optional[Callable[
+                     [List[Dict[str, Any]]], List[float]]] = None,
                  state_path: Optional[str] = None) -> None:
         if not tunes:
             raise ValueError("no Tune(...) markers found to optimize")
@@ -110,6 +140,15 @@ class GeneticOptimizer(Logger):
         #: batch evaluator — N genomes at once (subprocess fan-out);
         #: None = sequential in-process map over ``evaluate``
         self._evaluate_many = evaluate_many
+        #: cohort evaluator — N genomes OF ONE SHAPE SIGNATURE trained
+        #: as a single population-batched dispatch (the tpu-evaluator
+        #: pool's vmapped path).  When set, _fitness_many buckets each
+        #: generation by shape_signature() and dispatches one cohort
+        #: per bucket; a bucket whose cohort evaluation fails falls
+        #: back to the per-genome path — the parity oracle.
+        self._evaluate_cohort = evaluate_cohort
+        #: cohort sizes of the most recent generation (telemetry)
+        self.last_cohort_sizes: List[int] = []
         #: per-generation checkpoint file; run() resumes from it when
         #: it exists (reference parity: Genetics "spawns many workflow
         #: runs" and long GA runs must survive restarts)
@@ -205,6 +244,54 @@ class GeneticOptimizer(Logger):
         return fits
 
     def _fitness_many_inner(self, genomes: np.ndarray) -> np.ndarray:
+        if self._evaluate_cohort is not None:
+            return self._fitness_cohorts(genomes)
+        return self._fitness_serial(genomes)
+
+    def _fitness_cohorts(self, genomes: np.ndarray) -> np.ndarray:
+        """Bucket the generation by shape signature and train each
+        bucket as ONE population-batched cohort.  A genome whose decode
+        fails scores inf without poisoning its cohort; a bucket whose
+        cohort dispatch fails falls back to the per-genome oracle."""
+        fits = np.full(len(genomes), float("inf"), np.float64)
+        decoded: List[Optional[Dict[str, Any]]] = []
+        for g in genomes:
+            try:
+                decoded.append(self._decode(g))
+            except Exception as e:  # noqa: BLE001 — bad genes score inf
+                self.warning("genome decode failed (%s); scoring inf",
+                             e)
+                decoded.append(None)
+        buckets: Dict[Tuple, List[int]] = {}
+        for i, v in enumerate(decoded):
+            if v is None:
+                continue
+            buckets.setdefault(shape_signature(v, self.tunes),
+                               []).append(i)
+        self.last_cohort_sizes = [len(ix) for ix in buckets.values()]
+        if buckets:
+            self.info("cohorts: %d signature bucket(s), sizes %s",
+                      len(buckets), self.last_cohort_sizes)
+        for idxs in buckets.values():
+            try:
+                bf = self._evaluate_cohort([decoded[i] for i in idxs])
+                if len(bf) != len(idxs):
+                    raise ValueError(
+                        f"cohort evaluator returned {len(bf)} "
+                        f"fitnesses for {len(idxs)} genomes")
+                bf = [float("inf") if f is None else float(f)
+                      for f in bf]
+            except Exception as e:  # noqa: BLE001 — fall back, never
+                # abort: the per-genome path is the parity oracle
+                self.warning(
+                    "cohort evaluation failed for a %d-genome bucket "
+                    "(%s); falling back to per-genome evaluation",
+                    len(idxs), e)
+                bf = self._fitness_serial(genomes[idxs]).tolist()
+            fits[idxs] = bf
+        return fits
+
+    def _fitness_serial(self, genomes: np.ndarray) -> np.ndarray:
         if self._evaluate_many is None:
             return np.array([self._fitness(g) for g in genomes],
                             np.float64)

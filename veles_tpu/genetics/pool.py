@@ -149,12 +149,10 @@ class ChipEvaluatorPool(Logger):
 
     # -- evaluation ----------------------------------------------------
 
-    def evaluate_many(self, values_list: List[Dict[str, Any]]) \
-            -> List[float]:
-        """One generation: prep fans out over the thread workers, the
-        evaluator consumes the queue in submission order."""
-        if self._proc is None or self._proc.poll() is not None:
-            self.start()
+    def _prep_jobs(self, values_list: List[Dict[str, Any]]) \
+            -> List[Dict[str, Any]]:
+        """Fan the host-side staging hook out over the prep threads and
+        draw wire ids — the CPU-parallel share of a generation."""
         lock = threading.Lock()
 
         def prep_one(values):
@@ -166,34 +164,115 @@ class ChipEvaluatorPool(Logger):
             return {"id": jid, "values": values, "seed": self.seed}
 
         with ThreadPoolExecutor(self.workers) as pool:
-            jobs = list(pool.map(prep_one, values_list))
+            return list(pool.map(prep_one, values_list))
+
+    def evaluate_many(self, values_list: List[Dict[str, Any]]) \
+            -> List[float]:
+        """One generation: prep fans out over the thread workers, the
+        evaluator consumes the queue in submission order.
+
+        Failure contract: when the evaluator dies or hangs, the job at
+        the head of the unresolved queue was in flight — but an
+        evaluator-side death (OOM from a previous genome, a crashed
+        chip runtime) is not proof of a bad gene, so the in-flight
+        genome is RETRIED ONCE on the fresh evaluator before being
+        scored inf.  Three consecutive restarts that resolve nothing
+        mean the evaluator itself is broken: the remainder scores inf
+        rather than restart-looping forever."""
+        if self._proc is None or self._proc.poll() is not None:
+            self.start()
+        jobs = self._prep_jobs(values_list)
         order = [j["id"] for j in jobs]
         fits: Dict[int, float] = {}
         pending = list(jobs)
-        attempt = 0
-        while pending and attempt < 2:
-            attempt += 1
+        retried: set = set()
+        barren_restarts = 0
+        while pending:
             done = self._run_jobs(pending, fits)
             pending = [j for j in pending if j["id"] not in done]
-            if pending:
-                # the evaluator died or hung: the job at the head of
-                # the unresolved queue was in flight — score it inf
-                # (the bad gene), restart, retry the rest
-                bad = pending.pop(0)
-                fits[bad["id"]] = float("inf")
+            if not pending:
+                break
+            barren_restarts = 0 if done else barren_restarts + 1
+            if barren_restarts >= 3:
                 self.warning(
-                    "evaluator lost genome %s (%s); restarting for "
-                    "%d remaining", bad["id"], bad["values"],
-                    len(pending))
-                self._kill()
-                if pending:
-                    self.start()
-        for j in pending:   # second restart also failed: score inf
+                    "evaluator resolved nothing across %d consecutive "
+                    "restarts; scoring the remaining %d genomes inf",
+                    barren_restarts, len(pending))
+                break
+            head = pending[0]
+            if head["id"] in retried:
+                # the same genome killed a fresh evaluator twice —
+                # now the gene is the prime suspect: score it inf
+                pending.pop(0)
+                fits[head["id"]] = float("inf")
+                self.warning(
+                    "evaluator lost genome %s twice (%s); scoring inf,"
+                    " restarting for %d remaining", head["id"],
+                    head["values"], len(pending))
+            else:
+                # first loss: the evaluator may have died of its own
+                # accord — give the innocent-until-proven genome one
+                # retry on the fresh evaluator
+                retried.add(head["id"])
+                self.warning(
+                    "evaluator died with genome %s in flight; "
+                    "retrying it once on a fresh evaluator",
+                    head["id"])
+            self._kill()
+            if pending:
+                self.start()
+        for j in pending:   # broken-evaluator bailout: score inf
             fits[j["id"]] = float("inf")
         return [fits[i] for i in order]
 
     def evaluate_one(self, values: Dict[str, Any]) -> float:
         return self.evaluate_many([values])[0]
+
+    def evaluate_cohort(self, values_list: List[Dict[str, Any]]) \
+            -> List[float]:
+        """One same-shape-signature cohort as ONE evaluator job: the
+        serve process trains all members through the population-batched
+        vmapped engine (one compile per signature per run) and answers
+        with the per-member fitness list.  Prep still fans out over the
+        thread workers.  A dead evaluator gets one restart+retry of
+        the whole cohort; an evaluator-side error raises so the
+        GeneticOptimizer falls back to the per-genome oracle."""
+        if self._proc is None or self._proc.poll() is not None:
+            self.start()
+        jobs = self._prep_jobs(values_list)
+        job = {"id": jobs[0]["id"],
+               "members": [j["values"] for j in jobs],
+               "seed": self.seed}
+        timeout = self.timeout * max(1, len(values_list))
+        for attempt in (1, 2):
+            try:
+                self._proc.stdin.write(json.dumps(job) + "\n")
+                self._proc.stdin.flush()
+            except (BrokenPipeError, OSError):
+                msg = None
+            else:
+                msg = self._next_json(timeout)
+                while msg is not None and msg.get("id") != job["id"]:
+                    msg = self._next_json(timeout)
+            if msg is not None and "fitnesses" in msg:
+                fits = msg["fitnesses"]
+                if len(fits) != len(values_list):
+                    raise RuntimeError(
+                        f"evaluator returned {len(fits)} fitnesses "
+                        f"for a {len(values_list)}-member cohort")
+                return [float("inf") if f is None else float(f)
+                        for f in fits]
+            if msg is not None:   # evaluator-side error: not a death
+                raise RuntimeError(
+                    f"cohort failed in evaluator: {msg.get('error')}")
+            self.warning("evaluator died on a %d-member cohort "
+                         "(attempt %d); restarting",
+                         len(values_list), attempt)
+            self._kill()
+            self.start()
+        raise RuntimeError(
+            f"evaluator died twice on a {len(values_list)}-member "
+            f"cohort")
 
     def _run_jobs(self, jobs, fits: Dict[int, float]) -> set:
         """Stream ``jobs`` to the evaluator, collect results by id.

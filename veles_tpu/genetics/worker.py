@@ -84,15 +84,228 @@ def _release(launcher) -> None:
     gc.collect()
 
 
+def _rebuild_root(pristine, config_files, overrides, values) -> None:
+    """Rebuild the global config tree for one genome: pristine state ->
+    config files -> overrides -> tune substitution.  Reproduces the
+    per-process ``root`` isolation the one-shot mode's process boundary
+    provides, inside the persistent evaluator."""
+    import copy
+
+    from veles_tpu.config import parse_overrides, root
+    from veles_tpu.genetics import substitute_tunes
+    from veles_tpu.launcher import apply_config_file
+
+    root.__dict__.clear()
+    root.__dict__.update(copy.deepcopy(pristine))
+    for cf in config_files:
+        apply_config_file(cf)
+    parse_overrides(overrides)
+    substitute_tunes(root, values)
+
+
+class _CohortTooBig(Exception):
+    """Raised by the chunk trainer when the HBM accounting caps the
+    cohort below the attempted size; carries the admissible cap."""
+
+    def __init__(self, cap: int) -> None:
+        super().__init__(f"cohort over HBM budget; cap {cap}")
+        self.cap = max(1, cap)
+
+
+def _hbm_cohort_cap(workflow, requested: int) -> int:
+    """Largest member count one vmapped cohort may stack, from the
+    params x P accounting: per member the engine holds f32 params +
+    f32 momentum + a compute-dtype cast + transient grads — ~4
+    param-sized buffers.  The budget is the device's reported
+    ``bytes_limit`` (TPU) or ``VELES_TPU_GA_HBM_BUDGET`` (default
+    8 GiB where the backend reports none), with half held back for the
+    resident dataset + the cohort's activations."""
+    import os
+
+    import numpy as np
+
+    param_bytes = 0
+    for f in workflow.forwards:
+        for v in f.param_vectors().values():
+            if v:
+                param_bytes += int(np.prod(v.shape)) * 4
+    per_member = max(param_bytes * 4, 1)
+    budget = None
+    jdev = getattr(workflow.fused.device, "jax_device", None)
+    if jdev is not None:
+        try:
+            budget = int((jdev.memory_stats() or {})
+                         .get("bytes_limit", 0)) or None
+        except Exception:  # noqa: BLE001 — CPU backends report none
+            budget = None
+    if budget is None:
+        budget = int(os.environ.get("VELES_TPU_GA_HBM_BUDGET",
+                                    8 << 30))
+    cap = max(1, (budget // 2) // per_member)
+    if requested:
+        cap = min(cap, max(1, requested))
+    return cap
+
+
+def _structure_sig(workflow):
+    """Cheap structural fingerprint of a built (un-initialized)
+    workflow: the layer configs with the liftable per-member
+    hyperparameters stripped.  Members of one cohort MUST agree on it
+    — the vmapped engine trains every member at the representative's
+    shapes, so a member that decoded to a different structure would
+    otherwise silently train as somebody else's genome."""
+    from veles_tpu.genetics.core import LIFTABLE_HYPERS
+    sig = []
+    for cfg in getattr(workflow, "layers_config", []):
+        back = {k: v for k, v in dict(cfg.get("<-", {})).items()
+                if k not in LIFTABLE_HYPERS}
+        sig.append((cfg.get("type"),
+                    repr(sorted(dict(cfg.get("->", {})).items())),
+                    repr(sorted(back.items()))))
+    return tuple(sig)
+
+
+def _train_cohort_chunk(create, pristine, config_files, overrides,
+                        args, members, hypers, idxs, seed):
+    """Train ONE same-signature chunk via the population-batched
+    engine; returns its fitness list in ``idxs`` order.  Raises
+    _CohortTooBig when the HBM accounting says to split first."""
+    import numpy as np
+
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.ops.fused import PopulationTrainEngine
+
+    _rebuild_root(pristine, config_files, overrides, members[idxs[0]])
+    launcher = Launcher(backend=args.backend, seed=seed,
+                        verbose=args.verbose)
+    engine = None
+    try:
+        launcher.create_workflow(create)
+        launcher.initialize()
+        w = launcher.workflow
+        cap = _hbm_cohort_cap(w, args.cohort)
+        if len(idxs) > cap:
+            raise _CohortTooBig(cap)
+        rates = np.stack([hypers[i][0] for i in idxs])
+        decays = np.stack([hypers[i][1] for i in idxs])
+        engine = PopulationTrainEngine(w, rates, decays)
+        return [float(f) for f in engine.run()]
+    finally:
+        if engine is not None:
+            engine.release()
+        _release(launcher)
+
+
+def _evaluate_cohort(workflow_file, config_files, overrides, pristine,
+                     args, members, seed):
+    """One same-signature cohort -> per-member fitness list.
+
+    Per-member harvest first (rebuild root, build the workflow host-
+    side, read each genome's gd learning rates / weight decays): a
+    member whose decode or build fails scores inf WITHOUT poisoning
+    the cohort.  The valid members then train in population-batched
+    chunks; a chunk that fails (OOM included) splits in half and
+    retries — never crashes — and a failing singleton falls back to
+    the per-genome oracle path."""
+    import logging
+
+    import numpy as np
+
+    from veles_tpu import prng
+    from veles_tpu.launcher import load_workflow_module
+
+    log = logging.getLogger("veles_tpu.genetics.worker")
+    mod = load_workflow_module(workflow_file)
+    create = getattr(mod, "create_workflow", None)
+    if create is None:
+        raise RuntimeError(
+            f"{workflow_file}: cohort evaluation needs "
+            f"create_workflow(launcher)")
+
+    class _FL:
+        workflow = None
+
+    n = len(members)
+    fits = [float("inf")] * n
+    hypers = [None] * n
+    sig_ref = None
+    valid = []
+    for i, values in enumerate(members):
+        try:
+            _rebuild_root(pristine, config_files, overrides, values)
+            prng.seed_all(seed)
+            w = create(_FL())
+            sig = (len(w.gds), _structure_sig(w))
+            if sig_ref is None:
+                sig_ref = sig
+            elif sig != sig_ref:
+                raise ValueError(
+                    "model structure differs from the cohort "
+                    "representative (shape-signature mismatch)")
+            hypers[i] = (
+                np.asarray([[gd.learning_rate, gd.learning_rate_bias]
+                            if gd is not None else [0.0, 0.0]
+                            for gd in w.gds], np.float32),
+                np.asarray([[gd.weight_decay, gd.weight_decay_bias]
+                            if gd is not None else [0.0, 0.0]
+                            for gd in w.gds], np.float32))
+            valid.append(i)
+        except KeyboardInterrupt:
+            raise
+        except BaseException as e:  # noqa: BLE001 — bad gene: inf
+            log.warning("cohort member %d invalid (%s: %s); scoring "
+                        "inf", i, type(e).__name__, e)
+    if not valid:
+        return fits
+    pending = [list(valid)]
+    while pending:
+        idxs = pending.pop(0)
+        try:
+            chunk_fits = _train_cohort_chunk(
+                create, pristine, config_files, overrides, args,
+                members, hypers, idxs, seed)
+            for i, f in zip(idxs, chunk_fits):
+                fits[i] = f
+        except KeyboardInterrupt:
+            raise
+        except _CohortTooBig as e:
+            log.info("cohort of %d over HBM budget; chunking at %d",
+                     len(idxs), e.cap)
+            pending = [idxs[j:j + e.cap]
+                       for j in range(0, len(idxs), e.cap)] + pending
+        except BaseException as e:  # noqa: BLE001 — split, never crash
+            if len(idxs) == 1:
+                log.warning("cohort singleton %d failed batched (%s: "
+                            "%s); per-genome oracle fallback",
+                            idxs[0], type(e).__name__, e)
+                try:
+                    _rebuild_root(pristine, config_files, overrides,
+                                  members[idxs[0]])
+                    fits[idxs[0]] = _evaluate(
+                        workflow_file, args.backend, seed,
+                        args.verbose)
+                except KeyboardInterrupt:
+                    raise
+                except BaseException as e2:  # noqa: BLE001
+                    log.warning("oracle fallback for member %d also "
+                                "failed (%s); scoring inf", idxs[0],
+                                e2)
+            else:
+                half = len(idxs) // 2
+                log.warning("cohort chunk of %d failed (%s: %s); "
+                            "splitting and retrying", len(idxs),
+                            type(e).__name__, e)
+                pending = [idxs[:half], idxs[half:]] + pending
+    return fits
+
+
 def serve(args) -> int:
     """The chip-owning evaluation loop (tpu-evaluator mode)."""
     import copy
     import os
 
     from veles_tpu.backends import make_device
-    from veles_tpu.config import parse_overrides, root
-    from veles_tpu.genetics import substitute_tunes
-    from veles_tpu.launcher import apply_config_file
+    from veles_tpu.config import root
     from veles_tpu.logger import setup_logging
 
     setup_logging(10 if args.verbose else 20)
@@ -122,15 +335,20 @@ def serve(args) -> int:
             break
         result = {"id": job["id"], "pid": os.getpid()}
         try:
-            root.__dict__.clear()
-            root.__dict__.update(copy.deepcopy(pristine))
-            for cf in config_files:
-                apply_config_file(cf)
-            parse_overrides(overrides)
-            substitute_tunes(root, job["values"])
-            result["fitness"] = _evaluate(
-                workflow_file, args.backend,
-                int(job.get("seed", args.seed)), args.verbose)
+            if "members" in job:
+                # cohort job: same-signature genomes trained as one
+                # population-batched dispatch chain (chunked to the
+                # HBM budget; bad members score inf individually)
+                result["fitnesses"] = _evaluate_cohort(
+                    workflow_file, config_files, overrides, pristine,
+                    args, job["members"],
+                    int(job.get("seed", args.seed)))
+            else:
+                _rebuild_root(pristine, config_files, overrides,
+                              job["values"])
+                result["fitness"] = _evaluate(
+                    workflow_file, args.backend,
+                    int(job.get("seed", args.seed)), args.verbose)
         except KeyboardInterrupt:
             raise
         except BaseException as e:  # noqa: BLE001 — bad genes score
@@ -148,6 +366,10 @@ def main(argv=None) -> int:
     p.add_argument("--serve", action="store_true",
                    help="persistent chip-owning evaluator: genome jobs "
                         "as JSON lines on stdin, results on stdout")
+    p.add_argument("--cohort", type=int, default=0,
+                   help="serve mode: cap on the member count of one "
+                        "population-batched training dispatch "
+                        "(0 = auto, bounded by the HBM budget only)")
     p.add_argument("-b", "--backend", default="auto")
     p.add_argument("-s", "--seed", type=int, default=1234)
     p.add_argument("-v", "--verbose", action="store_true")

@@ -176,8 +176,30 @@ def init_multihost() -> None:
     except Exception:
         already = False
     if not already:
+        # The env-var contract this docstring promises is honored HERE:
+        # this jax's bare initialize() only auto-detects known cluster
+        # environments (SLURM, TPU pods) and raises "Number of
+        # processes must be defined" on a plain JAX_COORDINATOR_ADDRESS
+        # / JAX_PROCESS_ID / JAX_NUM_PROCESSES launch — so read them
+        # explicitly and pass them through (None = keep auto-detect).
+        coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or None
+        nproc = os.environ.get("JAX_NUM_PROCESSES")
+        pid = os.environ.get("JAX_PROCESS_ID")
+        if "cpu" in (os.environ.get("JAX_PLATFORMS") or "").lower():
+            # cross-process collectives on the CPU backend need the
+            # gloo transport selected BEFORE the backend initializes;
+            # without it every psum dies in the partitioner.  Config
+            # knob present on this jax; guarded for future removal.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:  # noqa: BLE001 — newer jax: gloo default
+                pass
         try:
-            jax.distributed.initialize()
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(nproc) if nproc else None,
+                process_id=int(pid) if pid else None)
         except RuntimeError as e:
             # Backend already up (e.g. the embedding process made a JAX
             # call first) — single-process semantics are the only safe

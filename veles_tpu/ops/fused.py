@@ -726,13 +726,8 @@ class EnsembleEvalEngine:
         self.n_members = len(member_params)
         self.compute_dtype = compute_dtype
         #: stacked params: {fwd_name: {pname: (n_members, ...)}} in HBM
-        self._params = {
-            f.name: {
-                pn: device.put(np.stack(
-                    [np.asarray(m[f.name][pn], np.float32)
-                     for m in member_params]))
-                for pn in member_params[0][f.name]}
-            for f in self.forwards}
+        self._params = _stack_member_params(self.forwards, member_params,
+                                            device)
         self._dataset = None
         self._labels = None
         self._predict = None
@@ -873,6 +868,374 @@ class EnsembleEvalEngine:
         self._labels = None
         self._predict = self._score = None
         self._predict_resident = self._score_resident = None
+
+
+def _stack_member_params(forwards, member_params, device):
+    """{fwd_name: {pname: (n_members, ...)}} — every member's f32
+    params stacked along a leading MEMBER axis and uploaded once.
+    Shared by the vmapped engines: EnsembleEvalEngine stacks N distinct
+    trained members; PopulationTrainEngine stacks P copies of one init
+    (same-signature genomes share the weight-init draw by seed)."""
+    return {
+        f.name: {
+            pn: device.put(np.stack(
+                [np.asarray(m[f.name][pn], np.float32)
+                 for m in member_params]))
+            for pn in member_params[0][f.name]}
+        for f in forwards}
+
+
+class PopulationTrainEngine:
+    """Population-batched GA training: P same-shape-signature genomes
+    trained in ONE vmapped fused scan per loader firing.
+
+    The chip-owning GA evaluator (genetics/worker.py --serve) trains
+    genomes strictly one at a time, and GA-scale genome nets (Wine /
+    MNIST-FC shapes) leave the MXU almost idle per dispatch.  This
+    engine applies the EnsembleEvalEngine move to TRAINING: the init
+    param pytree (identical across a cohort — same seed, same shapes)
+    is stacked P times along a leading member axis, optimizer state
+    and the metric accumulator gain the same axis, per-genome
+    hyperparameters (learning rates via the ``lr_rates`` contract,
+    weight decay via ``update_params(decays=...)``) become per-member
+    vectors, and the fused ``train_body`` chain is ``jax.vmap``ed over
+    the member axis inside one jitted donated dispatch.  The dataset
+    stays UNBATCHED: gather/ingest run before any batched array flows
+    in, so vmap broadcasts them and HBM cost is params x P, not
+    data x P.
+
+    Parity contract (pinned in tests/test_ga_cohort.py): the engine
+    drives the workflow's OWN loader exactly like the fused control
+    loop does (same superstep grouping, same shuffle stream, same
+    rng_counter advance on every firing) and mirrors DecisionGD's
+    min-error / max_epochs / fail_iterations bookkeeping PER MEMBER on
+    the host, so each member's fitness equals what the per-genome
+    oracle (a full workflow run of that genome) produces, to f32
+    tolerance.  Members that complete early stop updating their
+    fitness bookkeeping (their params keep training harmlessly until
+    the whole cohort is done — vmap has no per-member early exit).
+
+    The workflow must be built+initialized in fused mode on a jax
+    device with a device-resident loader; anything else raises
+    ValueError and the caller falls back to the per-genome oracle.
+    """
+
+    def __init__(self, workflow, member_rates: np.ndarray,
+                 member_decays: np.ndarray,
+                 compute_dtype: Any = None) -> None:
+        fused = getattr(workflow, "fused", None)
+        if fused is None or fused.loader is None or \
+                fused._train_step is None:
+            raise ValueError("PopulationTrainEngine needs a workflow "
+                             "initialized in fused mode")
+        device = fused.device
+        if device is None or not getattr(device, "is_jax", False):
+            raise ValueError(
+                "PopulationTrainEngine needs a jax device (TPU or "
+                "XLA:CPU); per-genome evaluation is the numpy path")
+        if fused.streaming or not getattr(fused.loader,
+                                          "device_resident", True):
+            raise ValueError(
+                "PopulationTrainEngine needs a device-resident "
+                "dataset (streaming loaders fall back to per-genome)")
+        self.workflow = workflow
+        self.fused = fused
+        self.loader = fused.loader
+        self.forwards = list(fused.forwards)
+        self.gds = list(fused.gds)
+        self.evaluator = fused.evaluator
+        self.decision = workflow.decision
+        self.lr_adjust = getattr(workflow, "lr_adjust", None)
+        self.device = device
+        self.compute_dtype = compute_dtype
+        rates = np.asarray(member_rates, np.float32)
+        decays = np.asarray(member_decays, np.float32)
+        n_gd = len(self.gds)
+        if rates.shape != decays.shape or rates.ndim != 3 or \
+                rates.shape[1:] != (n_gd, 2):
+            raise ValueError(
+                f"member hyperparameters must be (P, {n_gd}, 2) "
+                f"[lr, lr_bias] / [wd, wd_bias] arrays; got "
+                f"{rates.shape} / {decays.shape}")
+        self.n_members = int(rates.shape[0])
+        self._rates = rates
+        self._wd = device.put(decays)
+        # P copies of the single init pytree (Vectors hold the host
+        # master copy after initialize) stacked on the member axis
+        host = {f.name: {pn: np.asarray(v.map_read(), np.float32)
+                         for pn, v in f.param_vectors().items()}
+                for f in self.forwards}
+        self._params = _stack_member_params(
+            self.forwards, [host] * self.n_members, device)
+        self._opt = {}
+        for gd in self.gds:
+            if gd is None or not gd.accumulated_grads:
+                continue
+            self._opt[gd.name] = {
+                k: device.zeros((self.n_members,) + tuple(v.shape),
+                                np.float32)
+                for k, v in gd.accumulated_grads.items()}
+        self._acc = np.zeros((self.n_members, 3), np.float32)
+        self._rng_counter = 0
+        self._la_iteration = 0
+        self._train_step = None
+        self._eval_step = None
+        self._build()
+
+    # -- trace construction -------------------------------------------
+
+    def _resolved_dtype(self):
+        import jax.numpy as jnp
+        cd = self.compute_dtype
+        if cd is None:
+            cd = self.device.compute_dtype
+        return jnp.dtype(cd) if cd is not None else jnp.float32
+
+    def _build(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        forwards = self.forwards
+        gds = self.gds
+        evaluator = self.evaluator
+        n_fwd = len(forwards)
+        first_gd = next((i for i, g in enumerate(gds) if g is not None),
+                        -1)
+        seed = prng.get(self.fused.rng_stream).seed
+        cd = self._resolved_dtype()
+        mixed = cd != jnp.float32
+        dq = getattr(self.loader, "dequant", None)
+        if dq is not None:
+            q_scale = jnp.asarray(dq.scale, jnp.float32)
+            q_bias = jnp.asarray(dq.bias, jnp.float32)
+
+        def ingest(x):
+            if dq is None:
+                return x
+            return x.astype(jnp.float32) * q_scale + q_bias
+
+        def cast(tree):
+            if not mixed:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(cd) if a.dtype == jnp.float32 else a,
+                tree)
+
+        def forward_pass(params, x, rng_counter, train: bool):
+            # identical key chain to FusedStepRunner: cohort members
+            # share the per-genome oracle's seed, so dropout masks
+            # match it (and each other) exactly
+            residuals = []
+            if mixed:
+                x = x.astype(cd)
+            for i, f in enumerate(forwards):
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.key(seed),
+                                       rng_counter), i) \
+                    if f.stochastic else None
+                x, res = f.apply_fwd(params[f.name], x, rng=rng,
+                                     train=train)
+                residuals.append(res)
+            return x, residuals
+
+        def metrics_of(out, target, mask):
+            # no confusion matrix: the GA consumes n_err only
+            return evaluator.metrics_fn(out.astype(jnp.float32),
+                                        target, mask)
+
+        def member_train(params, opt, acc, lr, wd, dataset,
+                         target_store, indices, mask, rc0):
+            # ONE member's superstep scan — the same body shape the
+            # fused train_step scans, with per-member (lr, wd) closed
+            # in via vmapped arguments instead of unit attributes
+            def body(carry, xs):
+                params, opt, acc, rc = carry
+                idx, msk, lrow = xs
+                x = jnp.take(dataset, idx, axis=0)
+                target = jnp.take(target_store, idx, axis=0)
+                x = ingest(x)
+                cparams = cast(params)
+                out, residuals = forward_pass(cparams, x, rc, True)
+                m = metrics_of(out, target, msk)
+                err = m.pop("err_output")
+                if mixed:
+                    err = err.astype(cd)
+                new_params = dict(params)
+                new_opt = dict(opt)
+                for i in range(n_fwd - 1, -1, -1):
+                    f, gd = forwards[i], gds[i]
+                    if gd is None:
+                        continue
+                    if i == first_gd and gd.can_skip_err_input:
+                        _, grads = gd.backward_from_saved(
+                            cparams[f.name], residuals[i], err,
+                            need_err_input=False)
+                        err_in = None
+                    else:
+                        err_in, grads = gd.backward_from_saved(
+                            cparams[f.name], residuals[i], err)
+                    if grads:
+                        p, v = gd.update_params(
+                            params[f.name], grads,
+                            opt.get(gd.name, {}),
+                            rates=(lrow[i, 0], lrow[i, 1]),
+                            decays=(wd[i, 0], wd[i, 1]))
+                        new_params[f.name] = p
+                        if gd.name in opt:
+                            new_opt[gd.name] = v
+                    err = err_in
+                acc = acc + jnp.stack([m["n_err"], m["loss_sum"],
+                                       m["count"]])
+                return (new_params, new_opt, acc, rc + 1), None
+
+            (params, opt, acc, _), _ = lax.scan(
+                body, (params, opt, acc, rc0), (indices, mask, lr))
+            return params, opt, acc
+
+        def member_eval(params, acc, dataset, target_store, indices,
+                        mask, rc0):
+            cparams = cast(params)
+
+            def body(carry, xs):
+                acc, rc = carry
+                idx, msk = xs
+                x = jnp.take(dataset, idx, axis=0)
+                target = jnp.take(target_store, idx, axis=0)
+                out, _ = forward_pass(cparams, ingest(x), rc, False)
+                m = metrics_of(out, target, msk)
+                m.pop("err_output")
+                acc = acc + jnp.stack([m["n_err"], m["loss_sum"],
+                                       m["count"]])
+                return (acc, rc + 1), None
+
+            (acc, _), _ = lax.scan(body, (acc, rc0), (indices, mask))
+            return acc
+
+        # member axis on params/opt/acc/lr/wd; dataset, targets,
+        # indices, mask, rng_counter broadcast — x stays UNBATCHED
+        # through gather+ingest (vmap only batches where member-axis
+        # arrays flow in, i.e. from the first matmul on), so the
+        # cohort's HBM cost is params x P, not data x P
+        self._train_step = jax.jit(
+            jax.vmap(member_train,
+                     in_axes=(0, 0, 0, 0, 0, None, None, None, None,
+                              None)),
+            donate_argnums=(0, 1, 2))
+        self._eval_step = jax.jit(
+            jax.vmap(member_eval,
+                     in_axes=(0, 0, None, None, None, None, None)),
+            donate_argnums=(1,))
+
+    # -- per-member learning-rate schedule ----------------------------
+
+    def _member_lr(self, k: int) -> np.ndarray:
+        """(P, k, n_gd, 2) absolute rates for this train firing —
+        the member bases run through the workflow's LR policy with the
+        SAME (epoch/iteration) argument logic LearningRateAdjust.run
+        uses, so scheduled cohorts track the oracle exactly."""
+        P, n_gd = self._rates.shape[:2]
+        la = self.lr_adjust
+        if la is None:
+            return np.ascontiguousarray(np.broadcast_to(
+                self._rates[:, None], (P, k, n_gd, 2)))
+        ld = self.loader
+        e = ld.epoch_number
+        ended = bool(ld.epoch_ended)
+
+        def t_of(j: int) -> int:
+            if la.by == "epoch":
+                return e - 1 if (ended and j < k - 1) else e
+            return self._la_iteration + j
+
+        out = np.empty((P, k, n_gd, 2), np.float32)
+        for j in range(k):
+            t = t_of(j)
+            for m in range(P):
+                for gi in range(n_gd):
+                    out[m, j, gi, 0] = la.policy(
+                        float(self._rates[m, gi, 0]), t)
+                    out[m, j, gi, 1] = la.policy(
+                        float(self._rates[m, gi, 1]), t)
+        self._la_iteration += k
+        return out
+
+    # -- the run loop --------------------------------------------------
+
+    def run(self) -> np.ndarray:
+        """Train the whole cohort; returns the (P,) fitness vector —
+        each member's min validation n_err (train n_err for valid-less
+        configs), the exact quantity ``workflow_fitness`` reads off a
+        per-genome run's DecisionGD."""
+        from veles_tpu.loader.base import TRAIN, VALID
+
+        ld = self.loader
+        dec = self.decision
+        P = self.n_members
+        max_epochs = dec.max_epochs
+        fail_iters = dec.fail_iterations
+        has_valid = ld.class_lengths[VALID] > 0
+        min_valid = np.full(P, np.inf)
+        min_valid_epoch = np.full(P, -1, np.int64)
+        min_train = np.full(P, np.inf)
+        complete = np.zeros(P, bool)
+        dataset = ld.original_data.unmap()
+        targets = self.fused._target_store()
+        params, opt, acc = self._params, self._opt, self._acc
+        while not complete.all():
+            ld.run()
+            idxs, mask = ld.superstep_indices, ld.superstep_mask
+            k = idxs.shape[0]
+            klass = ld.minibatch_class
+            if klass == TRAIN:
+                params, opt, acc = self._train_step(
+                    params, opt, acc, self._member_lr(k), self._wd,
+                    dataset, targets, idxs, mask, self._rng_counter)
+            elif klass == VALID:
+                acc = self._eval_step(params, acc, dataset, targets,
+                                      idxs, mask, self._rng_counter)
+            # TEST firings never feed fitness: skip the dispatch but
+            # keep the rng_counter advance so dropout streams stay
+            # aligned with the oracle's firing count
+            self._rng_counter += k
+            if not bool(ld.class_ended):
+                continue
+            a = np.asarray(acc)          # one (P, 3) fetch per class
+            acc = np.zeros((P, 3), np.float32)
+            err = a[:, 0].astype(np.float64)
+            live = ~complete
+            if klass == VALID:
+                # DecisionGD.on_validation_ended, per member: strict
+                # improvement, epoch BEFORE the train class increments
+                better = live & (err < min_valid)
+                min_valid = np.where(better, err, min_valid)
+                min_valid_epoch = np.where(better, ld.epoch_number,
+                                           min_valid_epoch)
+            if klass == TRAIN:
+                if not has_valid:
+                    better = live & (err < min_train)
+                    min_train = np.where(better, err, min_train)
+                # DecisionGD.on_train_ended: epoch_number has already
+                # incremented past the ended epoch by now
+                epoch = ld.epoch_number
+                if max_epochs is not None and epoch >= max_epochs:
+                    complete[:] = True
+                if has_valid:
+                    complete |= (min_valid_epoch >= 0) & \
+                        (epoch - min_valid_epoch > fail_iters)
+        self._params, self._opt, self._acc = params, opt, acc
+        return min_valid if has_valid else min_train
+
+    def release(self) -> None:
+        """Drop the stacked device state (params + velocities + wd) —
+        same hygiene contract as release_device_state: a serve-mode
+        evaluator lives across many cohorts and HBM must not
+        accumulate."""
+        self._params = None
+        self._opt = None
+        self._acc = None
+        self._wd = None
+        self._train_step = self._eval_step = None
 
 
 def _pad_chunk(xb: np.ndarray, lb: np.ndarray, chunk: int):

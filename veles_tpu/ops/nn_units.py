@@ -304,21 +304,27 @@ class GradientUnit(AcceleratedUnit):
     def update_params(self, params: Dict[str, Any],
                       grads: Dict[str, Any],
                       velocities: Dict[str, Any],
-                      rates: Any = None) -> Tuple[Dict[str, Any],
-                                                  Dict[str, Any]]:
+                      rates: Any = None,
+                      decays: Any = None) -> Tuple[Dict[str, Any],
+                                                   Dict[str, Any]]:
         """Pure xp-agnostic SGD(+momentum) update; returns (new_params,
         new_velocities).  ``rates=(lr_weights, lr_bias)`` overrides the
         unit's own rates — the fused step threads per-minibatch rates
         through the scan this way, so the trace never bakes a
-        schedule-mutated ``self.learning_rate``."""
+        schedule-mutated ``self.learning_rate``.  ``decays=(wd_weights,
+        wd_bias)`` overrides the unit's weight decay the same way — the
+        population-batched GA engine threads PER-MEMBER decays through
+        its vmapped trace (a python ``self.weight_decay`` would bake
+        one genome's decay into every member's update)."""
         new_p, new_v = {}, {}
         lr_w, lr_b = rates if rates is not None else (
             self.learning_rate, self.learning_rate_bias)
+        wd_w, wd_b = decays if decays is not None else (
+            self.weight_decay, self.weight_decay_bias)
         for pname, w in params.items():
             g = grads[pname]
             lr = lr_w if pname == "weights" else lr_b
-            wd = self.weight_decay if pname == "weights" \
-                else self.weight_decay_bias
+            wd = wd_w if pname == "weights" else wd_b
             g = g + wd * w
             if self.gradient_moment:
                 v = velocities[pname]
