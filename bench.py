@@ -354,6 +354,49 @@ def multichip_dryrun_record():
         return False
 
 
+def fault_drill_metric(phase):
+    """Run the Faultline chaos drill (scripts/chaos_drill.py) as a
+    recorded phase: the full fault matrix — evaluator hang + garbage
+    line, torn snapshot, corrupt GA checkpoint, corrupt stream files,
+    device OOM, multihost peer death — injected on CPU and recovered
+    from, with per-fault recovery seconds.  Robustness gets a measured
+    trajectory in BENCH_r* exactly like performance does.  A
+    subprocess (CPU-pinned) because this process's jax client belongs
+    to the chip."""
+    if os.environ.get("BENCH_SKIP_FAULT_DRILL"):
+        return None
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    try:
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(here, "scripts", "chaos_drill.py"),
+             "--json"],
+            env=env, capture_output=True, text=True, timeout=900)
+        rec = json.loads(res.stdout.strip().splitlines()[-1])
+        results = rec["results"]
+        out = {
+            "fault_drill_ok": bool(rec["fault_drill_ok"]),
+            "fault_drill_recovery_sec": {
+                r["fault"]: r["recovery_sec"] for r in results},
+            "fault_drill_failures": [
+                r["fault"] for r in results if not r["ok"]] or None,
+        }
+        for r in results:
+            if r["fault"] == "evaluator.hang_and_garbage" and r["ok"]:
+                out["fault_drill_hang_detect_sec"] = \
+                    r.get("hang_detect_sec")
+        phase(f"fault drill: ok={out['fault_drill_ok']} "
+              f"{out['fault_drill_recovery_sec']}")
+        return out
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"fault drill failed to run: {e}", file=sys.stderr)
+        return None
+
+
 def ensemble_metric(device, phase):
     """Device-resident ensemble inference (ISSUE 3 tentpole): an
     N-member AlexNet-scale ensemble served as ONE vmapped jitted
@@ -952,6 +995,10 @@ def main() -> None:
         # only ever truncate enrichment
         "mnist_conv_time_to_99_sec": None,
         "multichip_dryrun_ok": None,
+        "fault_drill_ok": None,
+        "fault_drill_recovery_sec": None,
+        "fault_drill_hang_detect_sec": None,
+        "fault_drill_failures": None,
         "tpu_tests_passed": None,
         "tpu_tests_failed": None,
         "ensemble_members": None,
@@ -1016,6 +1063,12 @@ def main() -> None:
 
     phase("multichip dryrun (CPU-pinned subprocess)")
     record["multichip_dryrun_ok"] = multichip_dryrun_record()
+    emit()
+
+    phase("fault drill (chaos matrix, CPU-pinned subprocess)")
+    fd = fault_drill_metric(phase)
+    if fd:
+        record.update(fd)
     emit()
 
     phase("running tests_tpu on the chip (in-process)")

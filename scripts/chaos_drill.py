@@ -1,0 +1,446 @@
+"""Chaos drill: run the Faultline fault matrix on CPU and verify the
+supervision layer recovers from every injected failure.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/chaos_drill.py [--json] [--only F]
+
+Each drill arms one (or a pair of) named injection point(s)
+(veles_tpu/faults.py), exercises the REAL code path it lives in, and
+asserts the documented recovery: a hung evaluator is replaced within
+the heartbeat deadline, torn snapshots / GA checkpoints fall back to
+the newest intact predecessor, corrupt stream files are skipped and
+counted (and abort loudly past the tolerance), an OOMing upload
+degrades instead of dying, and a dying multihost peer aborts the
+survivors cleanly with a final snapshot.
+
+The last stdout line is one JSON record::
+
+    {"fault_drill_ok": bool, "results": [
+        {"fault": ..., "ok": bool, "recovery_sec": float, "detail":
+         ...}, ...]}
+
+bench.py runs this as its ``fault_drill`` phase, so robustness gets a
+measured trajectory in BENCH_r* exactly like performance does.
+``--only NAME`` (substring match) runs a subset; the multihost drill
+is the only one that spawns a process pair and respects
+``CHAOS_SKIP_MULTIHOST=1``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+# the drill is a CPU rehearsal: pin BEFORE any jax import so it can
+# run next to (not on) a chip
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(f"[chaos] {msg}", file=sys.stderr, flush=True)
+
+
+def drill(fn):
+    """Run one drill function -> result record (never raises)."""
+    name = fn.__name__.replace("drill_", "").replace("__", ".")
+    t0 = time.monotonic()
+    try:
+        detail = fn() or {}
+        ok = True
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:  # noqa: BLE001 — record, keep drilling
+        detail = {"error": f"{type(e).__name__}: {e}"}
+        ok = False
+    rec = {"fault": name, "ok": ok,
+           "recovery_sec": round(time.monotonic() - t0, 2)}
+    rec.update(detail)
+    log(f"{name}: {'OK' if ok else 'FAILED'} "
+        f"({rec['recovery_sec']}s) {detail}")
+    return rec
+
+
+# -- persistence drills ------------------------------------------------
+
+def drill_snapshot__torn_write():
+    from veles_tpu import faults
+    from veles_tpu.snapshotter import (SnapshotCorruptError,
+                                       load_workflow, save_workflow)
+    d = tempfile.mkdtemp(prefix="chaos_snap_")
+    p1 = os.path.join(d, "snap_epoch1.pickle.gz")
+    p2 = os.path.join(d, "snap_epoch2.pickle.gz")
+    save_workflow({"marker": 1}, p1)
+    faults.arm("snapshot.torn_write")
+    save_workflow({"marker": 2}, p2)
+    faults.arm("")
+    try:
+        load_workflow(p2)
+        raise AssertionError("torn snapshot loaded verbatim")
+    except SnapshotCorruptError:
+        pass
+    got = load_workflow(p2, fallback=True)
+    assert got == {"marker": 1}, got
+    return {"fell_back_to": os.path.basename(p1)}
+
+
+def drill_checkpoint__corrupt():
+    from veles_tpu import faults, prng
+    from veles_tpu.genetics import GeneticOptimizer, Tune
+
+    tunes = {"x": Tune(5.0, -10.0, 10.0), "y": Tune(-3.0, -10.0, 10.0)}
+
+    def quad(v):
+        return (v["x"] - 2.0) ** 2 + (v["y"] + 1.0) ** 2
+
+    d = tempfile.mkdtemp(prefix="chaos_ckpt_")
+    state = os.path.join(d, "ga.json")
+    prng.seed_all(4242)
+    _, fit_ref = GeneticOptimizer(quad, tunes, population=6,
+                                  generations=4,
+                                  state_path=state + ".ref").run()
+    # the FINAL checkpoint write is torn by the injected fault; the
+    # resume must fall back to .prev and still finish bit-identically
+    prng.seed_all(4242)
+    faults.arm("checkpoint.corrupt@gen=4")
+    GeneticOptimizer(quad, tunes, population=6, generations=4,
+                     state_path=state).run()
+    faults.arm("")
+    prng.seed_all(31337)   # irrelevant: resume restores the rng
+    _, fit2 = GeneticOptimizer(quad, tunes, population=6,
+                               generations=4, state_path=state).run()
+    assert abs(fit2 - fit_ref) < 1e-12, (fit2, fit_ref)
+    return {"bit_identical_resume": True}
+
+
+# -- loader drills -----------------------------------------------------
+
+def _make_image_tree(n=12, shape=(8, 8, 3)):
+    from PIL import Image
+    d = tempfile.mkdtemp(prefix="chaos_imgs_")
+    rng = np.random.default_rng(7)
+    paths = []
+    for i in range(n):
+        p = os.path.join(d, f"img_{i:02d}.png")
+        Image.fromarray(rng.integers(0, 255, shape, dtype="uint8")) \
+            .save(p)
+        paths.append((p, i % 3))
+    return paths
+
+
+def drill_stream__corrupt_file():
+    from veles_tpu import faults
+    from veles_tpu.loader.image import FileListImageLoader
+
+    paths = _make_image_tree()
+    # 1/12 corrupt under a 10% tolerance: skipped, counted, zero row
+    faults.arm("stream.corrupt_file@index=7")
+    ld = FileListImageLoader(train=paths, minibatch_size=4,
+                             target_shape=(8, 8, 3), streaming=False,
+                             corrupt_tolerance=0.1, name="chaosldr")
+    ld.load_data()
+    data = ld.original_data.mem
+    assert len(ld.corrupt_indices) == 1, ld.corrupt_indices
+    assert not data[sorted(ld.corrupt_indices)[0]].any()
+    good = [i for i in range(len(paths)) if i not in ld.corrupt_indices]
+    assert all(data[i].any() for i in good)
+    # 3/12 corrupt blows through the tolerance: must abort loudly
+    faults.arm("stream.corrupt_file@index=3,stream.corrupt_file@index=4"
+               ",stream.corrupt_file@index=5")
+    ld2 = FileListImageLoader(train=paths, minibatch_size=4,
+                              target_shape=(8, 8, 3), streaming=False,
+                              corrupt_tolerance=0.1, name="chaosldr2")
+    try:
+        ld2.load_data()
+        raise AssertionError("over-threshold corruption did not abort")
+    except RuntimeError as e:
+        assert "corrupt_tolerance" in str(e)
+    finally:
+        faults.arm("")
+    return {"skipped": 1, "threshold_aborted": True}
+
+
+def _tiny_workflow(streaming: bool):
+    from veles_tpu import prng
+    from veles_tpu.datasets import synthetic_classification
+    from veles_tpu.loader import ArrayLoader
+    from veles_tpu.ops.standard_workflow import StandardWorkflow
+    prng.seed_all(1357)
+    train, valid, _ = synthetic_classification(
+        160, 40, (8, 8, 1), n_classes=4, seed=7)
+    kw = {"max_resident_bytes": 0} if streaming else {}
+    gd = {"learning_rate": 0.1}
+    return StandardWorkflow(
+        loader_factory=lambda w: ArrayLoader(
+            w, train=train, valid=valid, minibatch_size=20,
+            name="loader", **kw),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": 2}, name="chaos_wf")
+
+
+def drill_device__oom_on_put_stream():
+    from veles_tpu import faults
+    from veles_tpu.backends import JaxDevice
+    w = _tiny_workflow(streaming=True)
+    w.initialize(device=JaxDevice(platform="cpu"))
+    assert w.fused.streaming
+    faults.arm("device.oom_on_put@site=stream")
+    try:
+        w.run()
+    finally:
+        faults.arm("")
+    assert w.fused.stream_oom_retries == 1, w.fused.stream_oom_retries
+    hist = [h for h in w.decision.history if h["class"] == "validation"]
+    assert hist and np.isfinite(hist[-1]["loss"])
+    w.stop()
+    return {"oom_retries": 1, "run_completed": True}
+
+
+def drill_device__oom_on_put_resident():
+    from veles_tpu import faults
+    from veles_tpu.backends import JaxDevice
+    w = _tiny_workflow(streaming=False)
+    faults.arm("device.oom_on_put@site=resident_dataset")
+    try:
+        w.initialize(device=JaxDevice(platform="cpu"))
+    finally:
+        faults.arm("")
+    # the budget said resident; the injected OOM degraded to streaming
+    assert not w.loader.device_resident
+    assert w.fused.streaming
+    w.run()
+    hist = [h for h in w.decision.history if h["class"] == "validation"]
+    assert hist and np.isfinite(hist[-1]["loss"])
+    w.stop()
+    return {"degraded_to_streaming": True}
+
+
+# -- evaluator drills (real serve-mode child process) ------------------
+
+def _wine_ga_files(d):
+    import textwrap
+    wf = os.path.join(d, "wf.py")
+    with open(wf, "w") as f:
+        f.write(textwrap.dedent("""
+            from veles_tpu.models import wine
+
+            def run(launcher):
+                launcher.create_workflow(wine.create_workflow)
+                launcher.initialize()
+                launcher.run()
+        """))
+    cfg = os.path.join(d, "cfg.py")
+    with open(cfg, "w") as f:
+        f.write(textwrap.dedent("""
+            from veles_tpu.config import root
+            from veles_tpu.genetics import Tune
+
+            root.wine.decision = {"max_epochs": 3}
+            root.wine.layers = [
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": Tune(0.3, 0.01, 1.0)}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.3}},
+            ]
+        """))
+    return wf, cfg
+
+
+def drill_evaluator__hang_and_garbage():
+    """The headline drill: a real serve-mode evaluator hangs SILENTLY
+    mid-genome (heartbeats stop too) and also tears the protocol with
+    a garbage line on another genome; the pool must detect the hang
+    within the heartbeat deadline, replace the evaluator, re-dispatch
+    the genome, and finish the generation with fitness parity against
+    an unfaulted pass."""
+    from veles_tpu.genetics.pool import ChipEvaluatorPool
+
+    d = tempfile.mkdtemp(prefix="chaos_ga_")
+    wf, cfg = _wine_ga_files(d)
+    lr = "wine.layers[0]['<-']['learning_rate']"
+    values = [{lr: 0.1}, {lr: 0.3}, {lr: 0.6}]
+    hb_deadline = float(os.environ.get("CHAOS_HB_DEADLINE", "10"))
+
+    def run_pool(fault_env):
+        env_key = "VELES_FAULTS"
+        saved = os.environ.get(env_key)
+        if fault_env:
+            os.environ[env_key] = fault_env
+        else:
+            os.environ.pop(env_key, None)
+        try:
+            pool = ChipEvaluatorPool(
+                [sys.executable, "-m", "veles_tpu.genetics.worker",
+                 "--serve", wf, cfg, "-b", "cpu", "-s", "1234",
+                 "--heartbeat-every", "0.5"],
+                workers=2, timeout=600,
+                heartbeat_deadline=hb_deadline,
+                restart_backoff=0.1)
+            with pool:
+                fits = pool.evaluate_many(values)
+            return pool, fits
+        finally:
+            if saved is None:
+                os.environ.pop(env_key, None)
+            else:
+                os.environ[env_key] = saved
+
+    _, fits_ref = run_pool("")
+    assert all(np.isfinite(f) for f in fits_ref), fits_ref
+    t0 = time.monotonic()
+    # job=2&seq=1: hang exactly once — on the first evaluator (job 2
+    # arrives as its second job), not on the replacement (where the
+    # retried job 2 comes first)
+    pool, fits = run_pool(
+        "evaluator.hang@job=2&seq=1&silent=1&seconds=600,"
+        "evaluator.garbage_line@job=1")
+    wall = time.monotonic() - t0
+    assert fits == fits_ref, (fits, fits_ref)
+    assert pool.hangs_detected >= 1, pool.hangs_detected
+    assert pool.last_hang_kind == "heartbeat", pool.last_hang_kind
+    assert pool.last_hang_wait <= hb_deadline + 5.0, pool.last_hang_wait
+    return {"hang_detect_sec": round(pool.last_hang_wait, 2),
+            "heartbeat_deadline": hb_deadline,
+            "fitness_parity": True, "wall_sec": round(wall, 1)}
+
+
+# -- multihost drill ---------------------------------------------------
+
+def drill_multihost__peer_exit():
+    """Process 1 of a 2-process CPU multihost run hard-exits shortly
+    after init (injected peer death); process 0 must NOT hang in the
+    collective — it aborts cleanly (exit 13) with a final snapshot."""
+    if os.environ.get("CHAOS_SKIP_MULTIHOST"):
+        return {"skipped": True}
+    import socket
+    import subprocess
+    import textwrap
+
+    d = tempfile.mkdtemp(prefix="chaos_mh_")
+    wf = os.path.join(d, "mh_wf.py")
+    with open(wf, "w") as f:
+        f.write(textwrap.dedent("""
+            from veles_tpu.workflow import Workflow
+
+
+            class PsumLoop(Workflow):
+                # keep running collectives until the peer dies under
+                # one of them — the watchdog (launcher.run) must abort
+                # this cleanly
+                def run(self):
+                    import time
+                    import jax
+                    import jax.numpy as jnp
+                    assert jax.process_count() == 2
+                    for _ in range(600):
+                        out = jax.pmap(
+                            lambda v: jax.lax.psum(v, "i"),
+                            axis_name="i")(
+                            jnp.ones(jax.local_device_count()))
+                        out.block_until_ready()
+                        time.sleep(0.1)
+
+
+            def create_workflow(launcher):
+                return PsumLoop(None, name="mh_chaos")
+
+
+            def run(launcher):
+                launcher.create_workflow(create_workflow)
+                launcher.initialize()
+                launcher.run()
+        """))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    snap_dir = os.path.join(d, "snaps")
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": "2",
+            "JAX_PROCESS_ID": str(pid),
+            "HOME": d,   # the emergency snapshot lands under $HOME
+            "VELES_FAULTS": "multihost.peer_exit@process=1&after=2",
+        })
+        env.pop("VELES_PLOTS_DIR", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "veles_tpu", "--multihost",
+             "-b", "cpu", wf],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=REPO, env=env))
+    del snap_dir
+    rcs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            rcs.append((p.returncode, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    rc0, err0 = rcs[0]
+    rc1, _ = rcs[1]
+    assert rc1 == 17, f"peer did not die as injected (rc={rc1})"
+    assert rc0 == 13, \
+        f"survivor rc={rc0}, wanted clean abort 13; stderr: {err0[-800:]}"
+    assert "aborting cleanly" in err0, err0[-800:]
+    snaps = []
+    for root, _, files in os.walk(d):
+        snaps += [f for f in files if f.startswith("multihost_abort")]
+    assert snaps, "no final snapshot written by the survivor"
+    return {"survivor_exit": rc0, "final_snapshot": snaps[0]}
+
+
+DRILLS = [
+    drill_snapshot__torn_write,
+    drill_checkpoint__corrupt,
+    drill_stream__corrupt_file,
+    drill_device__oom_on_put_stream,
+    drill_device__oom_on_put_resident,
+    drill_evaluator__hang_and_garbage,
+    drill_multihost__peer_exit,
+]
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(prog="chaos_drill")
+    p.add_argument("--json", action="store_true",
+                   help="stdout carries ONLY the final JSON record")
+    p.add_argument("--only", default=None,
+                   help="substring filter on drill names")
+    args = p.parse_args(argv)
+
+    todo = [f for f in DRILLS
+            if not args.only or args.only in f.__name__]
+    results = [drill(f) for f in todo]
+    ok = all(r["ok"] for r in results)
+    record = {"fault_drill_ok": ok, "results": results}
+    print(json.dumps(record), flush=True)
+    if not args.json:
+        log(f"{'ALL OK' if ok else 'FAILURES'} "
+            f"({sum(r['ok'] for r in results)}/{len(results)})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

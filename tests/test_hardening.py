@@ -277,9 +277,8 @@ class TestAtomicCompileCacheWrites:
     pid-tempfile + os.replace."""
 
     def test_patch_applied_and_atomic(self, tmp_path):
-        from veles_tpu.backends import (_enable_persistent_compile_cache,
-                                        _harden_compile_cache_writes)
-        _enable_persistent_compile_cache()  # idempotent; applies patch
+        from veles_tpu.backends import _harden_compile_cache_writes
+        _harden_compile_cache_writes()      # idempotent
         _harden_compile_cache_writes()      # second call = no-op
         from jax._src import lru_cache as lc
         assert getattr(lc.LRUCache.put, "_veles_atomic", False)
@@ -301,8 +300,19 @@ class TestAtomicCompileCacheWrites:
         could have torn: version + `-aw` era tag."""
         import jax
 
-        from veles_tpu.backends import _enable_persistent_compile_cache
-        _enable_persistent_compile_cache()
-        d = jax.config.jax_compilation_cache_dir
-        assert d is not None and d.endswith("-aw")
+        from veles_tpu.backends import _compile_cache_default_dir
+        d = _compile_cache_default_dir()
+        assert d.endswith("-aw")
         assert jax.__version__ in d
+
+    def test_cpu_process_never_enables_the_cache(self):
+        """Faultline root cause: XLA:CPU executables round-tripped
+        through the persistent cache deserialize to numerically WRONG
+        programs (nondeterministic NaN trainings + the GPF/SIGABRT
+        family).  A CPU-backend process must leave the cache off."""
+        import jax
+
+        from veles_tpu.backends import _enable_persistent_compile_cache
+        assert jax.default_backend() == "cpu"   # the test suite's pin
+        _enable_persistent_compile_cache()
+        assert jax.config.jax_compilation_cache_dir in (None, "")

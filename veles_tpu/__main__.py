@@ -63,9 +63,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "chip-owning evaluator process — the chip is "
                         "exclusive and is never probed from the GA "
                         "parent")
-    p.add_argument("--ga-eval-timeout", type=float, default=3600,
-                   help="seconds before a genome's training run is "
-                        "killed and scored inf (default 3600)")
+    p.add_argument("--ga-eval-timeout", "--eval-timeout",
+                   type=float, default=3600, dest="ga_eval_timeout",
+                   help="hard cap in seconds before a genome's "
+                        "training run is killed and scored inf "
+                        "(default 3600).  The chip-owning evaluator "
+                        "additionally enforces an ADAPTIVE per-genome "
+                        "deadline (4x the EMA of measured genome "
+                        "durations, floored at 60s), so a hung "
+                        "evaluator is replaced long before this cap")
+    p.add_argument("--heartbeat-deadline", type=float, default=60,
+                   help="tpu-evaluator mode: seconds of evaluator "
+                        "stdout silence (no heartbeat, no result) "
+                        "before it is declared hung and replaced "
+                        "(default 60; 0 disables heartbeat "
+                        "supervision)")
     p.add_argument("--ga-cohort", type=int, default=0,
                    help="tpu-evaluator mode: genomes sharing a shape "
                         "signature (identical integer tunes) train as "
@@ -371,9 +383,11 @@ def run_optimizer(args, workflow_file: str, config_files, overrides) \
                      "--cohort", str(max(0, args.ga_cohort))]
         if args.verbose:
             serve_cmd.append("-v")
-        pool = ChipEvaluatorPool(serve_cmd, workers=workers,
-                                 timeout=args.ga_eval_timeout,
-                                 seed=args.seed)
+        pool = ChipEvaluatorPool(
+            serve_cmd, workers=workers,
+            timeout=args.ga_eval_timeout,
+            heartbeat_deadline=args.heartbeat_deadline,
+            seed=args.seed)
         try:
             hello = pool.start()
         except Exception as e:  # noqa: BLE001 — fall back, not die

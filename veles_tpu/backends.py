@@ -142,6 +142,16 @@ def _enable_persistent_compile_cache() -> None:
     xla_extension, so the namespace retires every directory the old
     non-atomic writers could have corrupted, exactly like the round-5
     version-keying retired the flat dir.
+
+    CPU-only processes NEVER enable the cache (Faultline root cause):
+    on this jaxlib, XLA:CPU executables round-tripped through the
+    persistent cache deserialize to numerically WRONG programs —
+    reproduced as nondeterministic NaN trainings (~50% of identical
+    runs once entries were warm; bit-deterministic healthy with the
+    cache cold or off) plus the GPF/SIGABRT family, striking randomly
+    because the trace fingerprint also varies run to run.  CPU
+    compiles here are sub-second, so the cache bought nothing but the
+    corruption; the tunneled TPU's minutes-long compiles keep it.
     """
     import os
     if os.environ.get("VELES_TPU_NO_COMPILE_CACHE"):
@@ -149,16 +159,26 @@ def _enable_persistent_compile_cache() -> None:
     path = os.environ.get("VELES_TPU_COMPILE_CACHE_DIR")
     try:
         import jax
+        if jax.default_backend() == "cpu":
+            return
         _harden_compile_cache_writes()
         if path is None:
-            ver = getattr(jax, "__version__", "unknown")
-            path = os.path.join(
-                os.path.expanduser("~"), ".cache", "veles_tpu",
-                f"xla_cache-{ver}-aw")
+            path = _compile_cache_default_dir()
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
     except Exception:  # noqa: BLE001 — cache is an optimization only
         pass
+
+
+def _compile_cache_default_dir() -> str:
+    """The era-namespaced default cache dir (split out so tests can
+    assert the retirement naming without activating the cache)."""
+    import os
+
+    import jax
+    ver = getattr(jax, "__version__", "unknown")
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "veles_tpu", f"xla_cache-{ver}-aw")
 
 
 class JaxDevice(Device):
@@ -176,11 +196,13 @@ class JaxDevice(Device):
                  ordinal: int = 0, compute_dtype: Any = None) -> None:
         super().__init__()
         import jax
-        _enable_persistent_compile_cache()
         self._jax = jax
         devices = jax.devices(platform) if platform else jax.devices()
         self.jax_device = devices[ordinal]
         self.platform = self.jax_device.platform
+        # no-op for CPU-only processes — see the function's docstring
+        # (XLA:CPU executables do not survive the cache round-trip)
+        _enable_persistent_compile_cache()
         if compute_dtype is None:
             import jax.numpy as jnp
             compute_dtype = jnp.bfloat16 if self.platform == "tpu" \

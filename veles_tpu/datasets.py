@@ -26,6 +26,32 @@ import numpy as np
 
 Split = Tuple[np.ndarray, np.ndarray]
 
+#: (path-set description, error) per corrupt/foreign cache-file set
+#: skipped this run — the bounded-degradation ledger: skipping into
+#: the synthetic fallback is allowed, but never SILENTLY (Faultline)
+_corrupt_cache_skips: list = []
+_corrupt_cache_warned = False
+
+
+def corrupt_cache_count() -> int:
+    """Corrupt/foreign pre-placed dataset file sets skipped (and
+    warned about) so far this run."""
+    return len(_corrupt_cache_skips)
+
+
+def _note_corrupt_cache(what: str, exc: Exception) -> None:
+    global _corrupt_cache_warned
+    _corrupt_cache_skips.append((what, f"{type(exc).__name__}: {exc}"))
+    if not _corrupt_cache_warned:
+        _corrupt_cache_warned = True
+        import logging
+        logging.getLogger("veles_tpu.datasets").warning(
+            "corrupt/foreign pre-placed dataset files skipped — the "
+            "run continues on the SYNTHETIC fallback, which is almost "
+            "never what you want with real data present: %s (%s). "
+            "Further corrupt sets are counted silently "
+            "(datasets.corrupt_cache_count()).", what, exc)
+
 
 def data_dir() -> str:
     from veles_tpu.config import root
@@ -187,8 +213,12 @@ def try_load_real_cifar10() -> Optional[Tuple[Split, Split]]:
             try:
                 splits = [reader(p) for p in paths]
             except (ValueError, KeyError, EOFError, TypeError,
-                    OSError, pickle.UnpicklingError):
-                continue  # corrupt/foreign files -> synthetic fallback
+                    OSError, pickle.UnpicklingError) as e:
+                # corrupt/foreign files -> synthetic fallback, but
+                # COUNTED and warned once per run, never silent
+                _note_corrupt_cache(f"cifar10 batch set under {root}",
+                                    e)
+                continue
             tx = np.concatenate([s[0] for s in splits[:-1]])
             ty = np.concatenate([s[1] for s in splits[:-1]])
             return (tx, ty), splits[-1]

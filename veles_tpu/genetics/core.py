@@ -228,8 +228,18 @@ class GeneticOptimizer(Logger):
             self.warning("evaluation failed for %s: %s", values, e)
             return float("inf")
 
-    def _fitness_many(self, genomes: np.ndarray) -> np.ndarray:
+    #: env var naming the generation being evaluated — read by worker
+    #: processes (spawned per genome) and by the evaluator pool's job
+    #: payloads, so ``VELES_FAULTS`` qualifiers like ``@gen=2`` and
+    #: operator logs can target a specific generation
+    GENERATION_ENV = "VELES_GA_GENERATION"
+
+    def _fitness_many(self, genomes: np.ndarray,
+                      gen: Optional[int] = None) -> np.ndarray:
+        import os
         import time
+        if gen is not None:
+            os.environ[self.GENERATION_ENV] = str(gen)
         t0 = time.perf_counter()
         fits = self._fitness_many_inner(genomes)
         dt = time.perf_counter() - t0
@@ -317,12 +327,25 @@ class GeneticOptimizer(Logger):
                     fits: np.ndarray) -> None:
         """Atomic per-generation checkpoint: next generation to run,
         its (already evaluated) population, the full history, and the
-        GA RNG state — a resumed run continues bit-identically."""
+        GA RNG state — a resumed run continues bit-identically.
+
+        Integrity (Faultline): the state carries an embedded CRC32
+        (over the canonical JSON of everything else, so the file stays
+        ONE plain-json document), written through a pid-unique temp
+        file; the previous checkpoint is rotated to
+        ``<state_path>.prev`` so a write torn by a crash (or an
+        injected ``checkpoint.corrupt``) always leaves an intact
+        predecessor to resume from."""
         if not self.state_path:
             return
         import json
         import os
+        import tempfile
+        import zlib
+
+        from veles_tpu import faults
         state = {
+            "format": 2,
             "paths": self.paths,
             "generation": gen,
             "population": pop.tolist(),
@@ -330,18 +353,89 @@ class GeneticOptimizer(Logger):
             "history": [[(f, v) for f, v in g] for g in self.history],
             "rng_state": self.rng.bit_generator.state,
         }
-        tmp = self.state_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, self.state_path)
+        state["crc32"] = zlib.crc32(
+            json.dumps(state, sort_keys=True).encode()) & 0xFFFFFFFF
+        directory = os.path.dirname(os.path.abspath(self.state_path)) \
+            or "."
+        fd, tmp = tempfile.mkstemp(
+            dir=directory, suffix=".tmp",
+            prefix=os.path.basename(self.state_path) + ".")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(state, f)
+            if faults.fire("checkpoint.corrupt", gen=gen):
+                faults.truncate_file(tmp)
+            if os.path.exists(self.state_path):
+                os.replace(self.state_path, self.state_path + ".prev")
+            os.replace(tmp, self.state_path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    @staticmethod
+    def _read_state_file(path: str) -> dict:
+        """Parse + verify one checkpoint file; raises
+        SnapshotCorruptError on a torn/corrupt one."""
+        import json
+        import zlib
+
+        from veles_tpu.snapshotter import SnapshotCorruptError
+        try:
+            with open(path) as f:
+                state = json.load(f)
+            if int(state.get("format", 1)) >= 2:
+                want = state.pop("crc32", None)
+                if want is None:
+                    raise SnapshotCorruptError(
+                        f"{path}: format-2 checkpoint without its "
+                        f"embedded CRC (torn write)")
+                got = zlib.crc32(json.dumps(
+                    state, sort_keys=True).encode()) & 0xFFFFFFFF
+                if got != int(want):
+                    raise SnapshotCorruptError(f"{path}: CRC mismatch")
+        except SnapshotCorruptError:
+            raise
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            raise SnapshotCorruptError(
+                f"{path}: {type(e).__name__}: {e}") from e
+        return state
 
     def _load_state(self):
-        import json
+        """Resume data from the newest INTACT checkpoint: the state
+        file itself, else its ``.prev`` predecessor (resume then
+        re-runs one generation bit-identically).  Both corrupt raises
+        — a torn checkpoint must never silently restart the run."""
         import os
-        if not self.state_path or not os.path.exists(self.state_path):
+
+        from veles_tpu.snapshotter import SnapshotCorruptError
+        if not self.state_path or not (
+                os.path.exists(self.state_path)
+                or os.path.exists(self.state_path + ".prev")):
             return None
-        with open(self.state_path) as f:
-            state = json.load(f)
+        state = None
+        errors = []
+        for path in (self.state_path, self.state_path + ".prev"):
+            if not os.path.exists(path):
+                continue
+            try:
+                state = self._read_state_file(path)
+            except SnapshotCorruptError as e:
+                errors.append(str(e))
+                self.warning("GA checkpoint %s is corrupt (%s); "
+                             "trying predecessor", path, e)
+                continue
+            if path != self.state_path:
+                self.warning("resuming from intact predecessor %s",
+                             path)
+            break
+        if state is None:
+            raise SnapshotCorruptError(
+                f"GA checkpoint {self.state_path} and its .prev "
+                f"predecessor are both corrupt ({errors}); remove "
+                f"them to start a fresh run")
         if state["paths"] != self.paths:
             raise ValueError(
                 f"GA state file {self.state_path} was written for "
@@ -377,7 +471,7 @@ class GeneticOptimizer(Logger):
             # generations+1 entries and duplicate the final append
             self.history = []
             pop = self._initial_population()
-            fits = self._fitness_many(pop)
+            fits = self._fitness_many(pop, gen=0)
             self._save_state(0, pop, fits)
         for gen in range(start_gen, self.generations):
             order = np.argsort(fits)
@@ -395,7 +489,7 @@ class GeneticOptimizer(Logger):
             new = np.asarray(nxt)
             new_fits = np.concatenate([
                 fits[:self.elite],
-                self._fitness_many(new[self.elite:])])
+                self._fitness_many(new[self.elite:], gen=gen + 1)])
             pop, fits = new, new_fits
             self._save_state(gen + 1, pop, fits)
         # the last bred population WAS evaluated — record it, or

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -300,10 +301,18 @@ def _evaluate_cohort(workflow_file, config_files, overrides, pristine,
 
 
 def serve(args) -> int:
-    """The chip-owning evaluation loop (tpu-evaluator mode)."""
+    """The chip-owning evaluation loop (tpu-evaluator mode).
+
+    Emits periodic heartbeat lines (``{"hb": n, "pid", "job"}``)
+    from a daemon thread so the parent pool can tell a slow genome
+    from a wedged process — see genetics/pool.py for the deadlines
+    the heartbeats feed.  ``--heartbeat-every 0`` disables.
+    """
     import copy
     import os
+    import threading
 
+    from veles_tpu import faults
     from veles_tpu.backends import make_device
     from veles_tpu.config import root
     from veles_tpu.logger import setup_logging
@@ -324,8 +333,34 @@ def serve(args) -> int:
              "backend": device.backend_name, "platform": platform,
              "is_accelerator": bool(device.is_jax
                                     and platform != "cpu")}
-    print(json.dumps(hello), flush=True)
 
+    # ALL protocol lines go through one lock so the heartbeat thread
+    # can never interleave bytes into a result line
+    emit_lock = threading.Lock()
+
+    def emit(obj) -> None:
+        with emit_lock:
+            print(json.dumps(obj), flush=True)
+
+    emit(hello)
+
+    hb_state = {"job": None, "silent": False}
+    hb_stop = threading.Event()
+
+    def _hb_loop() -> None:
+        n = 0
+        while not hb_stop.wait(args.heartbeat_every):
+            if hb_state["silent"]:
+                continue
+            emit({"hb": n, "pid": os.getpid(),
+                  "job": hb_state["job"]})
+            n += 1
+
+    if args.heartbeat_every > 0:
+        threading.Thread(target=_hb_loop, daemon=True,
+                         name="serve-heartbeat").start()
+
+    seq = 0   # ordinal of the job within this evaluator's life
     for line in sys.stdin:
         line = line.strip()
         if not line:
@@ -334,7 +369,19 @@ def serve(args) -> int:
         if job.get("op") == "shutdown":
             break
         result = {"id": job["id"], "pid": os.getpid()}
+        hb_state["job"] = job["id"]
+        fault_ctx = {"job": job["id"], "seq": seq}
+        if "gen" in job:
+            fault_ctx["gen"] = job["gen"]
+        seq += 1
         try:
+            hang = faults.fire("evaluator.hang", **fault_ctx)
+            if hang:
+                # a stall mid-genome: heartbeats keep flowing unless
+                # the drill asked for a fully wedged process (silent)
+                hb_state["silent"] = bool(hang.get("silent"))
+                faults.hang(float(hang.get("seconds", 3600.0)))
+                hb_state["silent"] = False
             if "members" in job:
                 # cohort job: same-signature genomes trained as one
                 # population-batched dispatch chain (chunked to the
@@ -354,7 +401,16 @@ def serve(args) -> int:
         except BaseException as e:  # noqa: BLE001 — bad genes score
             # inf at the parent; the evaluator must outlive them
             result["error"] = f"{type(e).__name__}: {e}"
-        print(json.dumps(result), flush=True)
+        hb_state["job"] = None
+        if faults.fire("evaluator.garbage_line", **fault_ctx):
+            # a torn protocol line (e.g. a crashing library printing
+            # over stdout) — the pool must treat it as noise + proof
+            # of life, never as a result
+            with emit_lock:
+                print(faults.garbage_text(point="evaluator"),
+                      flush=True)
+        emit(result)
+    hb_stop.set()
     return 0
 
 
@@ -370,6 +426,12 @@ def main(argv=None) -> int:
                    help="serve mode: cap on the member count of one "
                         "population-batched training dispatch "
                         "(0 = auto, bounded by the HBM budget only)")
+    p.add_argument("--heartbeat-every", type=float,
+                   default=float(os.environ.get(
+                       "VELES_HEARTBEAT_EVERY", "5.0")),
+                   help="serve mode: seconds between heartbeat lines "
+                        "on stdout (default 5, or "
+                        "$VELES_HEARTBEAT_EVERY; 0 disables)")
     p.add_argument("-b", "--backend", default="auto")
     p.add_argument("-s", "--seed", type=int, default=1234)
     p.add_argument("-v", "--verbose", action="store_true")

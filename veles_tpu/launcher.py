@@ -48,6 +48,7 @@ class Launcher(Logger):
         self.plotters = plotters
         self.status_server = status_server
         self.profile_dir = profile
+        self.multihost = multihost
         prng.seed_all(seed)
         if multihost:
             init_multihost()
@@ -71,7 +72,10 @@ class Launcher(Logger):
         if self.snapshot:
             from veles_tpu.snapshotter import load_workflow
             self.info("resuming from %s", self.snapshot)
-            self.workflow = load_workflow(self.snapshot)
+            # fallback=True: a torn/corrupt snapshot resumes from the
+            # newest intact sibling instead of killing the run (and
+            # raises when none is intact — never a silent fresh start)
+            self.workflow = load_workflow(self.snapshot, fallback=True)
         else:
             self.workflow = factory(self, **kwargs)
         if self.plotters and hasattr(self.workflow, "link_plotters"):
@@ -110,22 +114,205 @@ class Launcher(Logger):
 
     def run(self) -> None:
         from veles_tpu import profiling
-        with profiling.trace(self.profile_dir):
-            if self.mode == "standalone":
-                self.workflow.run()
-            elif self.mode == "master":
-                from veles_tpu.server import MasterServer
-                MasterServer(self.workflow, self.listen_address).serve()
-            else:
-                if not self.device.is_jax:
-                    raise ValueError(
-                        "slave mode computes jobs with the fused jitted "
-                        "step — use a jax backend (-b tpu/jax/cpu), "
-                        "not numpy")
-                from veles_tpu.client import SlaveClient
-                SlaveClient(self.workflow, self.master_address).serve()
+        watchdog_stop = self._start_multihost_watchdog() \
+            if self.multihost else None
+        try:
+            with profiling.trace(self.profile_dir):
+                if self.mode == "standalone":
+                    self.workflow.run()
+                elif self.mode == "master":
+                    from veles_tpu.server import MasterServer
+                    MasterServer(self.workflow,
+                                 self.listen_address).serve()
+                else:
+                    if not self.device.is_jax:
+                        raise ValueError(
+                            "slave mode computes jobs with the fused "
+                            "jitted step — use a jax backend (-b "
+                            "tpu/jax/cpu), not numpy")
+                    from veles_tpu.client import SlaveClient
+                    SlaveClient(self.workflow,
+                                self.master_address).serve()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            if self.multihost:
+                # a dying peer surfaces here as a failed collective
+                # (gloo/XLA distributed error) — abort CLEANLY with a
+                # final snapshot instead of hanging or losing the run
+                self._abort_multihost(e)
+            raise
+        finally:
+            if watchdog_stop is not None:
+                watchdog_stop()
         if self.profile_dir:
             self._dump_flops_table()
+
+    #: exit code of a clean multihost peer-failure abort (documented
+    #: in docs/guide.md "Operating long runs")
+    MULTIHOST_ABORT_EXIT = 13
+
+    def _emergency_snapshot(self) -> Optional[str]:
+        """Best-effort final snapshot for an abort path; None when it
+        could not be written (the abort must land regardless)."""
+        try:
+            if self.workflow is None:
+                return None
+            from veles_tpu.snapshotter import save_workflow
+            snap = getattr(self.workflow, "snapshotter", None)
+            directory = snap.directory if snap is not None else \
+                os.path.join(os.path.expanduser("~"),
+                             ".veles_tpu", "snapshots")
+            os.makedirs(directory, exist_ok=True)
+            path = os.path.join(
+                directory,
+                f"multihost_abort_pid{os.getpid()}.pickle.gz")
+            return save_workflow(self.workflow, path)
+        except Exception as e:  # noqa: BLE001 — the abort must land
+            self.warning("emergency snapshot failed: %s", e)
+            return None
+
+    def _abort_multihost(self, exc: BaseException) -> None:
+        """A collective failed under --multihost (peer death, network
+        partition): write a final emergency snapshot of the local
+        workflow state and exit with a distinctive code — the
+        operator's restart-from-snapshot path, not a hang and not a
+        lost run."""
+        path = self._emergency_snapshot()
+        self.error(
+            "multihost collective failed (%s: %s) — peer death or "
+            "partition; aborting cleanly%s",
+            type(exc).__name__, exc,
+            f"; final snapshot: {path}" if path else
+            " (no snapshot written)")
+        # os._exit, not SystemExit: a normal interpreter exit runs
+        # jax's atexit distributed.shutdown(), whose Shutdown barrier
+        # waits on the DEAD peer until the coordination service
+        # SIGABRTs this process (~100 s) — the clean abort must skip
+        # that barrier entirely
+        import logging
+        import sys as _sys
+        logging.shutdown()
+        _sys.stderr.flush()
+        os._exit(self.MULTIHOST_ABORT_EXIT)
+
+    def _start_multihost_watchdog(self):
+        """Cross-process liveness over the distributed KV store.
+
+        A dying peer does NOT reliably surface as a catchable error:
+        the XLA coordination service only declares a silent task
+        unhealthy after ~100 s and then hard-ABORTS every remaining
+        process from a C++ thread (SIGABRT — no Python except path,
+        no snapshot), while a collective against the dead peer can
+        block the main thread indefinitely.  So each process
+        publishes a heartbeat key every ``$VELES_MULTIHOST_HEARTBEAT``
+        (default 2 s) seconds, and a watchdog thread per peer blocks
+        on the peer's next key with a ``$VELES_MULTIHOST_DEADLINE``
+        (default 15 s) timeout.  A missed deadline (and no clean
+        ``done`` marker) means the peer is gone: write the emergency
+        snapshot (bounded wait — the main thread may be wedged inside
+        the dead collective) and ``os._exit(13)``, well before the
+        coordination service's own fatal abort.
+
+        Returns a stop() callable (publishes this process's clean
+        ``done`` marker), or None when not in a real multi-process
+        run."""
+        import threading
+        try:
+            import jax
+            from jax._src.distributed import global_state
+            client = global_state.client
+            if client is None or jax.process_count() <= 1:
+                return None
+            me = jax.process_index()
+            peers = [p for p in range(jax.process_count()) if p != me]
+        except Exception:  # noqa: BLE001 — no distributed context
+            return None
+        interval = float(os.environ.get("VELES_MULTIHOST_HEARTBEAT",
+                                        "2.0"))
+        deadline = float(os.environ.get("VELES_MULTIHOST_DEADLINE",
+                                        "15.0"))
+        stop = threading.Event()
+
+        def beat() -> None:
+            seq = 0
+            while not stop.wait(interval):
+                try:
+                    client.key_value_set(f"veles_hb/{me}/{seq}", "1")
+                except Exception:  # noqa: BLE001 — coordination gone;
+                    return         # its own abort path is in flight
+                seq += 1
+
+        def watch(peer: int) -> None:
+            seq = 0
+            while not stop.is_set():
+                try:
+                    client.blocking_key_value_get(
+                        f"veles_hb/{peer}/{seq}",
+                        int(deadline * 1000))
+                    seq += 1
+                    continue
+                except Exception:  # noqa: BLE001 — timeout or error
+                    pass
+                if stop.is_set():
+                    return
+                try:   # did the peer just finish cleanly?
+                    client.blocking_key_value_get(
+                        f"veles_done/{peer}", 2000)
+                    return
+                except Exception:  # noqa: BLE001
+                    pass
+                if stop.is_set():
+                    return
+                self._peer_death_abort(peer, deadline)
+
+        threading.Thread(target=beat, daemon=True,
+                         name="mh-heartbeat").start()
+        for p in peers:
+            threading.Thread(target=watch, args=(p,), daemon=True,
+                             name=f"mh-watch-{p}").start()
+        self.info("multihost watchdog up: %d peer(s), heartbeat "
+                  "%.1fs, deadline %.1fs", len(peers), interval,
+                  deadline)
+
+        def stopper() -> None:
+            stop.set()
+            try:
+                client.key_value_set(f"veles_done/{me}", "1")
+            except Exception:  # noqa: BLE001 — shutdown race
+                pass
+
+        return stopper
+
+    def _peer_death_abort(self, peer: int, deadline: float) -> None:
+        """Watchdog-thread abort: the main thread may be blocked in a
+        collective against the dead peer, so the snapshot is written
+        from here with a bounded grace period, then the process exits
+        with the clean abort code (never hangs, never waits for the
+        coordination service's SIGABRT)."""
+        import threading
+        self.error(
+            "multihost peer %d missed its liveness deadline (%.1fs) — "
+            "peer death/partition; writing a final snapshot and "
+            "aborting cleanly", peer, deadline)
+        result: dict = {}
+
+        def snap() -> None:
+            result["path"] = self._emergency_snapshot()
+
+        t = threading.Thread(target=snap, daemon=True,
+                             name="mh-final-snapshot")
+        t.start()
+        t.join(timeout=30.0)
+        path = result.get("path")
+        self.error("multihost peer failure: aborting cleanly%s",
+                   f"; final snapshot: {path}" if path
+                   else " (snapshot did not complete)")
+        # stderr flush then hard exit: the main thread cannot be
+        # unblocked from a dead collective
+        import logging
+        logging.shutdown()
+        os._exit(self.MULTIHOST_ABORT_EXIT)
 
     def stop(self) -> None:
         if self.workflow is not None:
@@ -209,6 +396,39 @@ def init_multihost() -> None:
                 "jax.distributed.initialize() refused (%s); continuing "
                 "single-process", e)
     _multihost_initialized = True
+    _maybe_inject_peer_exit()
+
+
+def _maybe_inject_peer_exit() -> None:
+    """Faultline ``multihost.peer_exit``: hard-exit THIS process (now,
+    or ``after`` seconds on a timer thread) so the drill can rehearse
+    a dying peer — the surviving processes must abort cleanly with a
+    final snapshot (Launcher._abort_multihost), never hang."""
+    from veles_tpu import faults
+    if not faults.active():
+        return
+    proc = os.environ.get("JAX_PROCESS_ID")
+    if proc is None:
+        try:
+            import jax
+            proc = str(jax.process_index())
+        except Exception:  # noqa: BLE001 — no distributed context
+            proc = "0"
+    f = faults.fire("multihost.peer_exit", process=proc)
+    if not f:
+        return
+    delay = float(f.get("after", 0.0))
+    if delay <= 0:
+        os._exit(17)
+    import threading
+    import time as _time
+
+    def _die():
+        _time.sleep(delay)
+        os._exit(17)
+
+    threading.Thread(target=_die, daemon=True,
+                     name="fault-peer-exit").start()
 
 
 def load_workflow_module(path: str):

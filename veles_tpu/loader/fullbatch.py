@@ -178,12 +178,42 @@ class FullBatchLoader(Loader):
                       self.original_data.mem.dtype,
                       self._resident_budget() / 2 ** 30)
         resident = self.on_device and self.device_resident
+        if resident and device is not None and device.is_jax:
+            try:
+                from veles_tpu import faults
+                if faults.fire("device.oom_on_put",
+                               site="resident_dataset"):
+                    raise RuntimeError(
+                        "RESOURCE_EXHAUSTED: fault-injected OOM on "
+                        "the resident dataset upload")
+                for v in (self.original_data, self.original_labels,
+                          self.original_targets):
+                    if v:
+                        v.initialize(device)
+                        v.unmap()  # one-time HBM upload
+                return
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — degrade, see below
+                if "RESOURCE_EXHAUSTED" not in str(e):
+                    raise
+                # bounded degradation: the budget said the dataset
+                # fits but the device disagreed (fragmentation, other
+                # tenants) — stream superstep batches from host
+                # instead of dying at initialize
+                self.warning(
+                    "dataset upload hit device OOM (%s) — falling "
+                    "back to host streaming", e)
+                self.device_resident = False
+                for v in (self.original_data, self.original_labels,
+                          self.original_targets):
+                    if v:
+                        v.drop_devmem()
+                resident = False
         for v in (self.original_data, self.original_labels,
                   self.original_targets):
             if v:
                 v.initialize(device if resident else None)
-                if device is not None and device.is_jax and resident:
-                    v.unmap()  # one-time HBM upload
 
     def create_minibatch_data(self) -> None:
         mb = self.max_minibatch_size

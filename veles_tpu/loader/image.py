@@ -71,6 +71,7 @@ class FileListImageLoader(FullBatchLoader):
                  streaming: Any = "auto",
                  decode_workers: int = 0,
                  norm_sample: int = 512,
+                 corrupt_tolerance: float = 0.01,
                  **kwargs: Any) -> None:
         super().__init__(workflow, **kwargs)
         self.file_lists = {TRAIN: list(train or ()),
@@ -81,6 +82,15 @@ class FileListImageLoader(FullBatchLoader):
         self.streaming = streaming
         self.decode_workers = decode_workers  # 0 = cpu count (cap 16)
         self.norm_sample = norm_sample
+        #: bounded degradation: a corrupt/undecodable file is SKIPPED
+        #: (zero row substituted) and counted, mid-epoch, instead of
+        #: killing a multi-hour run — but once more than
+        #: ``corrupt_tolerance`` of the dataset's files are bad the
+        #: loader aborts LOUDLY (a dying disk/dataset must not
+        #: silently train on zeros).  0.0 = abort on the first one.
+        self.corrupt_tolerance = float(corrupt_tolerance)
+        #: global indices of files that failed to decode this run
+        self.corrupt_indices: set = set()
         self._paths: List[str] = []
         self._stream = False
         self._decode_pool = None
@@ -98,6 +108,8 @@ class FileListImageLoader(FullBatchLoader):
     def __setstate__(self, state: dict) -> None:
         super().__setstate__(state)
         self.__dict__.setdefault("_decode_raw", False)
+        self.__dict__.setdefault("corrupt_tolerance", 0.01)
+        self.__dict__.setdefault("corrupt_indices", set())
 
     def _flat_entries(self) -> List[Tuple[str, int]]:
         """All (path, label) laid out [test | valid | train] to match
@@ -137,8 +149,48 @@ class FileListImageLoader(FullBatchLoader):
     # -- decoding ------------------------------------------------------
 
     def _decode_one(self, i: int) -> np.ndarray:
-        return decode_image(self._paths[i], self.target_shape,
-                            self.normalize, raw=self._decode_raw)
+        from veles_tpu import faults
+        try:
+            if faults.fire("stream.corrupt_file", index=int(i),
+                           path=self._paths[i]):
+                raise OSError(
+                    f"fault-injected corrupt file: {self._paths[i]}")
+            return decode_image(self._paths[i], self.target_shape,
+                                self.normalize, raw=self._decode_raw)
+        except (KeyboardInterrupt, MemoryError):
+            raise
+        except Exception as e:  # noqa: BLE001 — bounded degradation:
+            # skip-and-count, abort loudly past the tolerance
+            self._record_corrupt(int(i), e)
+            return np.zeros(self.target_shape,
+                            np.uint8 if self._decode_raw
+                            else np.float32)
+
+    def _record_corrupt(self, i: int, exc: Exception) -> None:
+        """Count a corrupt file (once per file), warn on the first few,
+        and abort loudly once more than ``corrupt_tolerance`` of the
+        dataset is bad — skipping must stay BOUNDED degradation."""
+        new = i not in self.corrupt_indices
+        self.corrupt_indices.add(i)
+        n_bad, n_all = len(self.corrupt_indices), max(len(self._paths),
+                                                      1)
+        if new and n_bad <= 5:
+            self.warning(
+                "corrupt image skipped (%d bad of %d): %s (%s: %s)%s",
+                n_bad, n_all, self._paths[i], type(exc).__name__, exc,
+                "; further corrupt files counted silently"
+                if n_bad == 5 else "")
+        allowed = max(1, int(self.corrupt_tolerance * n_all)) \
+            if self.corrupt_tolerance > 0 else 0
+        if n_bad > allowed:
+            raise RuntimeError(
+                f"{self.name}: {n_bad}/{n_all} files failed to decode "
+                f"— over the corrupt_tolerance="
+                f"{self.corrupt_tolerance:g} threshold ({allowed} "
+                f"allowed); the dataset (or the disk under it) is "
+                f"bad, aborting instead of training on zeros. "
+                f"Last failure: {self._paths[i]} "
+                f"({type(exc).__name__}: {exc})") from exc
 
     def _decode_batch(self, indices: np.ndarray) -> np.ndarray:
         """Decode rows for global ``indices``, fanning PIL decodes out

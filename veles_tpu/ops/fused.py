@@ -98,6 +98,9 @@ class FusedStepRunner(AcceleratedUnit):
         #: the codec actually moved per sample (uint8 ingest must show
         #: <= half the bf16 wire, a quarter of f32)
         self.stream_transfer_bytes = 0
+        #: times a streaming upload OOMed and recovered by draining
+        #: the double-buffer (Faultline telemetry; see _run_streaming)
+        self.stream_oom_retries = 0
 
     _unpicklable = AcceleratedUnit._unpicklable + (
         "_train_step", "_eval_step", "_params", "_opt", "mesh",
@@ -520,8 +523,33 @@ class FusedStepRunner(AcceleratedUnit):
         # tests divide this by processed images
         self.stream_transfer_bytes += int(xb.nbytes) + int(tb.nbytes)
         t_transfer = time.perf_counter()
-        xb = jax.device_put(xb, dst)
-        tb = jax.device_put(tb, dst)
+        for attempt in (1, 2):
+            try:
+                from veles_tpu import faults
+                if faults.fire("device.oom_on_put", site="stream"):
+                    raise RuntimeError(
+                        "RESOURCE_EXHAUSTED: fault-injected OOM on "
+                        "the streaming upload")
+                xb_dev = jax.device_put(xb, dst)
+                tb_dev = jax.device_put(tb, dst)
+                break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — transient HBM
+                # pressure: the double-buffer may still hold two
+                # superstep batches worth of HBM — drain it and retry
+                # ONCE before giving up (bounded degradation, not an
+                # unbounded retry loop)
+                if "RESOURCE_EXHAUSTED" not in str(e) or attempt == 2:
+                    raise
+                self.warning(
+                    "streaming upload hit device OOM (%s); draining "
+                    "the in-flight double-buffer and retrying once", e)
+                self.stream_oom_retries += 1
+                while self._inflight:
+                    for buf in self._inflight.popleft():
+                        buf.block_until_ready()
+        xb, tb = xb_dev, tb_dev
         if self.mesh is not None:
             mask = jax.device_put(mask, self._batch_sharding)
         self._inflight.append((xb, tb))
@@ -677,6 +705,7 @@ class FusedStepRunner(AcceleratedUnit):
         self.__dict__.setdefault("streaming", False)
         self.__dict__.setdefault("stream_transfer_seconds", 0.0)
         self.__dict__.setdefault("stream_transfer_bytes", 0)
+        self.__dict__.setdefault("stream_oom_retries", 0)
         from collections import deque
         if self.__dict__.get("_inflight") is None:  # dropped by pickle
             self._inflight = deque()
@@ -1167,8 +1196,17 @@ class PopulationTrainEngine:
         each member's min validation n_err (train n_err for valid-less
         configs), the exact quantity ``workflow_fitness`` reads off a
         per-genome run's DecisionGD."""
+        from veles_tpu import faults
         from veles_tpu.loader.base import TRAIN, VALID
 
+        if faults.fire("device.oom_on_put", site="cohort",
+                       members=self.n_members):
+            # surfaces exactly like a real cohort OOM: the serve-mode
+            # evaluator's chunk trainer catches it, halves the cohort
+            # and retries (genetics/worker.py _evaluate_cohort)
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: fault-injected OOM on the "
+                "cohort dispatch")
         ld = self.loader
         dec = self.decision
         P = self.n_members

@@ -11,6 +11,16 @@ executables (Unit._unpicklable), and the fused runner folds its donated
 param/optimizer pytrees back into Vectors.  Resume re-attaches a device
 and re-jits.  PRNG stream states ride along so stochastic ops continue
 their exact sequences.
+
+Integrity (Faultline): snapshots carry a CRC32 envelope —
+``MAGIC | length | crc | pickle`` inside the compression stream — and
+writes go through a pid-unique temp file + ``os.replace`` (concurrent
+writers can no longer tear each other's ``.tmp``).  Loads verify the
+envelope; a torn or corrupt file raises ``SnapshotCorruptError``, and
+``load_workflow(path, fallback=True)`` walks the sibling snapshots
+newest-first to resume from the newest INTACT predecessor instead of
+crashing — and raises (never silently starts fresh) when none is
+intact.  Pre-envelope snapshots still load (no CRC to check).
 """
 
 from __future__ import annotations
@@ -20,14 +30,28 @@ import gzip
 import lzma
 import os
 import pickle
+import struct
+import tempfile
 import time
-from typing import Any, Optional
+import zlib
+from typing import Any, List, Optional
 
-from veles_tpu import prng
+from veles_tpu import faults, prng
 from veles_tpu.units import Unit
 
 _OPENERS = {".gz": gzip.open, ".bz2": bz2.open, ".xz": lzma.open,
             "": open}
+
+#: CRC-envelope magic (format 2); files not starting with it are
+#: pre-envelope format-1 snapshots (bare pickle) and load unverified
+MAGIC = b"VSNPCRC2"
+_HEADER = struct.Struct("<QI")   # payload length, crc32
+
+
+class SnapshotCorruptError(RuntimeError):
+    """A snapshot/checkpoint file is torn or corrupt (bad magic
+    continuation, short read, CRC mismatch, or a codec/unpickle error
+    consistent with truncation)."""
 
 
 def _opener(path: str):
@@ -39,25 +63,134 @@ def _opener(path: str):
 
 def save_workflow(workflow, path: str) -> str:
     """Pickle (workflow, prng state) to ``path`` (compression by
-    suffix: .gz/.bz2/.xz)."""
+    suffix: .gz/.bz2/.xz) inside a CRC32 envelope, via a pid-unique
+    temp file + atomic ``os.replace`` — two concurrent writers (e.g. a
+    Snapshotter next to a manual save) can never tear each other."""
     payload = {
-        "format": 1,
+        "format": 2,
         "workflow": workflow,
         "prng": prng.snapshot_state(),
         "timestamp": time.time(),
     }
-    tmp = path + ".tmp"
-    with _opener(path)(tmp, "wb") as f:
-        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-    os.replace(tmp, path)
+    blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    crc = zlib.crc32(blob) & 0xFFFFFFFF
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(path) + ".", suffix=".tmp")
+    os.close(fd)
+    try:
+        with _opener(path)(tmp, "wb") as f:
+            f.write(MAGIC)
+            f.write(_HEADER.pack(len(blob), crc))
+            f.write(blob)
+        if faults.fire("snapshot.torn_write", path=path):
+            faults.truncate_file(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
     return path
 
 
-def load_workflow(path: str):
+def _read_payload(path: str) -> dict:
+    """Read + verify one snapshot file; SnapshotCorruptError on any
+    tear/corruption."""
+    try:
+        with _opener(path)(path, "rb") as f:
+            head = f.read(len(MAGIC))
+            if head == MAGIC:
+                meta = f.read(_HEADER.size)
+                if len(meta) != _HEADER.size:
+                    raise SnapshotCorruptError(
+                        f"{path}: truncated envelope header")
+                length, crc = _HEADER.unpack(meta)
+                blob = f.read(length)
+                if len(blob) != length:
+                    raise SnapshotCorruptError(
+                        f"{path}: truncated payload "
+                        f"({len(blob)}/{length} bytes)")
+                if (zlib.crc32(blob) & 0xFFFFFFFF) != crc:
+                    raise SnapshotCorruptError(f"{path}: CRC mismatch")
+                return pickle.loads(blob)
+            # pre-envelope format 1: bare pickle, no CRC to verify
+            rest = head + f.read()
+        return pickle.loads(rest)
+    except SnapshotCorruptError:
+        raise
+    except (OSError, EOFError, zlib.error, lzma.LZMAError,
+            pickle.UnpicklingError, ValueError, struct.error,
+            AttributeError, ImportError, IndexError,
+            MemoryError, OverflowError) as e:
+        # gzip raises BadGzipFile(OSError)/EOFError on tears; a torn
+        # bare pickle surfaces as UnpicklingError/EOF/Value/Index;
+        # Attribute/ImportError = pickled against classes that no
+        # longer resolve — all mean "not an intact snapshot"
+        raise SnapshotCorruptError(f"{path}: {type(e).__name__}: {e}") \
+            from e
+
+
+def snapshot_candidates(path: str) -> List[str]:
+    """Sibling snapshot files of ``path`` (same directory, same
+    prefix family), newest-first by mtime, excluding ``path`` itself —
+    the fallback order for a corrupt snapshot."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    # the family prefix: everything before the rolling part.  The
+    # Snapshotter names files <prefix>_epoch<N>...; manual saves share
+    # at least the leading alpha run of the basename.
+    stem = base.split("_epoch")[0] if "_epoch" in base \
+        else os.path.splitext(base)[0]
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return []
+    cands = []
+    for name in entries:
+        if name == base or name.endswith(".tmp"):
+            continue
+        if not name.startswith(stem):
+            continue
+        full = os.path.join(directory, name)
+        if os.path.isfile(full):
+            cands.append(full)
+    cands.sort(key=lambda p: os.path.getmtime(p), reverse=True)
+    return cands
+
+
+def load_workflow(path: str, fallback: bool = False):
     """Restore a workflow; caller must .initialize(device=...) before
-    .run() (re-attaches devices, re-jits, reloads non-pickled data)."""
-    with _opener(path)(path, "rb") as f:
-        payload = pickle.load(f)
+    .run() (re-attaches devices, re-jits, reloads non-pickled data).
+
+    ``fallback=True``: when ``path`` is torn/corrupt, walk its sibling
+    snapshots newest-first and resume from the newest intact one
+    (long runs survive a crash mid-snapshot-write); raises the
+    original SnapshotCorruptError when nothing intact remains — a
+    corrupt snapshot must never silently become a fresh start."""
+    import logging
+    log = logging.getLogger("veles_tpu.snapshotter")
+    try:
+        payload = _read_payload(path)
+    except SnapshotCorruptError as e:
+        if not fallback:
+            raise
+        log.warning("snapshot %s is corrupt (%s); looking for the "
+                    "newest intact predecessor", path, e)
+        payload = None
+        for cand in snapshot_candidates(path):
+            try:
+                payload = _read_payload(cand)
+            except SnapshotCorruptError as e2:
+                log.warning("predecessor %s also corrupt (%s)",
+                            cand, e2)
+                continue
+            log.warning("resuming from intact predecessor %s "
+                        "instead of corrupt %s", cand, path)
+            break
+        if payload is None:
+            raise
     prng.restore_state(payload["prng"])
     return payload["workflow"]
 
