@@ -50,6 +50,19 @@ def log(msg: str) -> None:
     print(f"[chaos] {msg}", file=sys.stderr, flush=True)
 
 
+def assert_journal_event(name: str, since: int = 0) -> dict:
+    """Every drill must leave its expected Sightline event in the run
+    journal — a fault that is recovered from but not REPORTED would
+    leave the operator blind.  Returns the newest matching event (from
+    the in-process ring, which mirrors the journal file)."""
+    from veles_tpu import telemetry
+    evs = telemetry.recent_events(name)
+    assert len(evs) > since, \
+        f"no {name!r} event in the telemetry journal " \
+        f"(have: {sorted({e['event'] for e in telemetry.recent_events()})})"
+    return evs[-1]
+
+
 def drill(fn):
     """Run one drill function -> result record (never raises)."""
     name = fn.__name__.replace("drill_", "").replace("__", ".")
@@ -90,7 +103,10 @@ def drill_snapshot__torn_write():
         pass
     got = load_workflow(p2, fallback=True)
     assert got == {"marker": 1}, got
-    return {"fell_back_to": os.path.basename(p1)}
+    ev = assert_journal_event("snapshot.fallback")
+    assert ev["used"] == p1, ev
+    return {"fell_back_to": os.path.basename(p1),
+            "journal_event": "snapshot.fallback"}
 
 
 def drill_checkpoint__corrupt():
@@ -119,7 +135,10 @@ def drill_checkpoint__corrupt():
     _, fit2 = GeneticOptimizer(quad, tunes, population=6,
                                generations=4, state_path=state).run()
     assert abs(fit2 - fit_ref) < 1e-12, (fit2, fit_ref)
-    return {"bit_identical_resume": True}
+    ev = assert_journal_event("ga.checkpoint_fallback")
+    assert ev["used"].endswith(".prev"), ev
+    return {"bit_identical_resume": True,
+            "journal_event": "ga.checkpoint_fallback"}
 
 
 # -- loader drills -----------------------------------------------------
@@ -166,7 +185,10 @@ def drill_stream__corrupt_file():
         assert "corrupt_tolerance" in str(e)
     finally:
         faults.arm("")
-    return {"skipped": 1, "threshold_aborted": True}
+    assert_journal_event("loader.corrupt_file")
+    assert_journal_event("loader.corrupt_over_tolerance")
+    return {"skipped": 1, "threshold_aborted": True,
+            "journal_event": "loader.corrupt_file"}
 
 
 def _tiny_workflow(streaming: bool):
@@ -207,7 +229,10 @@ def drill_device__oom_on_put_stream():
     hist = [h for h in w.decision.history if h["class"] == "validation"]
     assert hist and np.isfinite(hist[-1]["loss"])
     w.stop()
-    return {"oom_retries": 1, "run_completed": True}
+    ev = assert_journal_event("device.oom_retry")
+    assert ev["site"] == "stream", ev
+    return {"oom_retries": 1, "run_completed": True,
+            "journal_event": "device.oom_retry"}
 
 
 def drill_device__oom_on_put_resident():
@@ -226,7 +251,10 @@ def drill_device__oom_on_put_resident():
     hist = [h for h in w.decision.history if h["class"] == "validation"]
     assert hist and np.isfinite(hist[-1]["loss"])
     w.stop()
-    return {"degraded_to_streaming": True}
+    ev = assert_journal_event("device.oom_degraded")
+    assert ev["site"] == "resident_dataset", ev
+    return {"degraded_to_streaming": True,
+            "journal_event": "device.oom_degraded"}
 
 
 # -- evaluator drills (real serve-mode child process) ------------------
@@ -314,9 +342,13 @@ def drill_evaluator__hang_and_garbage():
     assert pool.hangs_detected >= 1, pool.hangs_detected
     assert pool.last_hang_kind == "heartbeat", pool.last_hang_kind
     assert pool.last_hang_wait <= hb_deadline + 5.0, pool.last_hang_wait
+    ev = assert_journal_event("ga.hang_detected")
+    assert ev["kind"] == "heartbeat", ev
+    assert_journal_event("ga.evaluator_restart")
     return {"hang_detect_sec": round(pool.last_hang_wait, 2),
             "heartbeat_deadline": hb_deadline,
-            "fitness_parity": True, "wall_sec": round(wall, 1)}
+            "fitness_parity": True, "wall_sec": round(wall, 1),
+            "journal_event": "ga.hang_detected"}
 
 
 # -- multihost drill ---------------------------------------------------
@@ -407,7 +439,26 @@ def drill_multihost__peer_exit():
     for root, _, files in os.walk(d):
         snaps += [f for f in files if f.startswith("multihost_abort")]
     assert snaps, "no final snapshot written by the survivor"
-    return {"survivor_exit": rc0, "final_snapshot": snaps[0]}
+    # the survivor's journal (its own process wrote journal-<pid>.jsonl
+    # into the shared metrics dir it inherited via $VELES_METRICS_DIR)
+    # must carry the abort record — the drill verifies REPORTING, not
+    # just recovery
+    from veles_tpu import telemetry
+    ev_names = set()
+    mdir = telemetry.metrics_dir()
+    if mdir:
+        import glob
+        for jf in glob.glob(os.path.join(mdir, "journal-*.jsonl")):
+            with open(jf) as f:
+                for line in f:
+                    try:
+                        ev_names.add(json.loads(line)["event"])
+                    except (ValueError, KeyError):
+                        pass
+    assert "multihost.emergency_snapshot" in ev_names, \
+        f"survivor journal lacks the abort record (saw {sorted(ev_names)})"
+    return {"survivor_exit": rc0, "final_snapshot": snaps[0],
+            "journal_event": "multihost.emergency_snapshot"}
 
 
 DRILLS = [
@@ -430,11 +481,25 @@ def main(argv=None) -> int:
                    help="substring filter on drill names")
     args = p.parse_args(argv)
 
+    # every drill also verifies its fault REPORTS into the Sightline
+    # journal; arm a scratch metrics dir when the caller did not
+    # (child processes inherit it through $VELES_METRICS_DIR)
+    from veles_tpu import telemetry
+    if telemetry.metrics_dir() is None:
+        telemetry.configure(tempfile.mkdtemp(prefix="chaos_metrics_"))
+    log(f"journal/metrics dir: {telemetry.metrics_dir()}")
+
     todo = [f for f in DRILLS
             if not args.only or args.only in f.__name__]
     results = [drill(f) for f in todo]
     ok = all(r["ok"] for r in results)
-    record = {"fault_drill_ok": ok, "results": results}
+    record = {
+        "fault_drill_ok": ok,
+        "fault_drill_journal_verified": bool(results) and all(
+            r.get("journal_event") or r.get("skipped")
+            for r in results),
+        "results": results,
+    }
     print(json.dumps(record), flush=True)
     if not args.json:
         log(f"{'ALL OK' if ok else 'FAILURES'} "

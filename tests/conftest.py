@@ -42,12 +42,22 @@ def _isolated_data_dir(tmp_path_factory):
 
 @pytest.fixture(autouse=True)
 def _reset_global_state():
-    """Each test gets a clean config tree and PRNG registry."""
-    from veles_tpu import config, prng
+    """Each test gets a clean config tree, PRNG registry, and
+    telemetry registry (zeroed in place; no metrics dir armed)."""
+    from veles_tpu import config, prng, telemetry
     saved = dict(config.root.__dict__)
+    saved_mdir = os.environ.pop(telemetry.ENV_DIR, None)
+    telemetry.reset()
+    telemetry.set_enabled(True)
     prng._streams.clear()
     prng.seed_all(1234)
     yield
     config.root.__dict__.clear()
     config.root.__dict__.update(saved)
     prng._streams.clear()
+    if saved_mdir is not None:
+        os.environ[telemetry.ENV_DIR] = saved_mdir
+    else:
+        os.environ.pop(telemetry.ENV_DIR, None)
+    telemetry.reset()
+    telemetry.set_enabled(True)

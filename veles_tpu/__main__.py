@@ -118,6 +118,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="append every log record to FILE as JSON "
                         "lines (the reference's run-event DB sink, "
                         "file-shaped)")
+    p.add_argument("--metrics-dir", default=None, metavar="DIR",
+                   help="Sightline telemetry: write per-process "
+                        "metrics snapshots (metrics-<pid>.json) and "
+                        "the run journal (journal-<pid>.jsonl) into "
+                        "DIR; exported as $VELES_METRICS_DIR so GA "
+                        "evaluators and multihost peers inherit it.  "
+                        "Render with scripts/obs_report.py DIR")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("--dump-config", action="store_true",
                    help="print the effective config tree and exit")
@@ -154,6 +161,10 @@ def main(argv=None) -> int:
 
         from veles_tpu.logger import add_jsonl_sink
         atexit.register(add_jsonl_sink(args.log_events))
+
+    if args.metrics_dir:
+        from veles_tpu import telemetry
+        telemetry.configure(args.metrics_dir)
 
     if args.backend == "tpu-evaluator" and not args.optimize:
         print("-b tpu-evaluator is a GA execution mode — it needs "

@@ -6,7 +6,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu import prng
+from veles_tpu import prng, telemetry
 from veles_tpu.config import Config
 from veles_tpu.logger import Logger
 
@@ -245,9 +245,16 @@ class GeneticOptimizer(Logger):
         dt = time.perf_counter() - t0
         #: cumulative (evaluations, seconds) — the GA's own throughput
         #: record, so execution modes (cpu fan-out vs the chip-owning
-        #: evaluator) are comparable on the same run log
+        #: evaluator) are comparable on the same run log; the registry
+        #: carries the same totals (``ga.evaluations``/
+        #: ``ga.eval_seconds``) plus the per-round distribution
         self.eval_count += len(genomes)
         self.eval_seconds += dt
+        telemetry.counter("ga.evaluations").inc(len(genomes))
+        telemetry.counter("ga.eval_seconds").inc(dt)
+        telemetry.histogram("ga.generation_seconds").record(dt)
+        telemetry.event("ga.generation_evaluated", gen=gen,
+                        genomes=len(genomes), seconds=round(dt, 2))
         if dt > 0:
             self.info("evaluated %d genomes in %.1fs (%.2f genomes/s)",
                       len(genomes), dt, len(genomes) / dt)
@@ -428,10 +435,15 @@ class GeneticOptimizer(Logger):
                              "trying predecessor", path, e)
                 continue
             if path != self.state_path:
+                telemetry.counter("ga.checkpoint_fallbacks").inc()
+                telemetry.event("ga.checkpoint_fallback",
+                                corrupt=self.state_path, used=path)
                 self.warning("resuming from intact predecessor %s",
                              path)
             break
         if state is None:
+            telemetry.event("ga.checkpoint_unrecoverable",
+                            path=self.state_path)
             raise SnapshotCorruptError(
                 f"GA checkpoint {self.state_path} and its .prev "
                 f"predecessor are both corrupt ({errors}); remove "
@@ -478,6 +490,8 @@ class GeneticOptimizer(Logger):
             pop, fits = pop[order], fits[order]
             self.history.append([(float(f), self._decode(g))
                                  for f, g in zip(fits, pop)])
+            telemetry.event("ga.generation", gen=gen,
+                            best=float(fits[0]))
             self.info("generation %d: best=%.4f %s", gen, fits[0],
                       self._decode(pop[0]))
             nxt = list(pop[:self.elite])

@@ -53,6 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from veles_tpu import telemetry
 from veles_tpu.logger import Logger
 
 
@@ -115,13 +116,52 @@ class ChipEvaluatorPool(Logger):
         #: EMA of measured per-genome durations (seconds) — feeds the
         #: adaptive deadline; survives evaluator restarts
         self.genome_duration_ema: Optional[float] = None
-        #: supervision telemetry (drills/bench read these)
-        self.hangs_detected = 0
-        self.restarts = 0
+        #: supervision telemetry: counts live in the process-wide
+        #: registry (``ga.hangs_detected``/``ga.evaluator_restarts``)
+        #: — the ``hangs_detected``/``restarts`` properties report this
+        #: pool's share via construction-time baselines; the last-hang
+        #: fields describe the CURRENT generation only (reset by
+        #: ``_begin_generation``)
+        self._hangs_base = telemetry.counter(
+            "ga.hangs_detected").value
+        self._restarts_base = telemetry.counter(
+            "ga.evaluator_restarts").value
         self.last_hang_wait: Optional[float] = None
         self.last_hang_kind: Optional[str] = None
         self._consecutive_restarts = 0
+        #: child pids whose final metrics snapshot was already merged
+        self._adopted_pids: set = set()
         self._backoff_rng = np.random.default_rng(seed ^ 0x5EED)
+
+    @property
+    def hangs_detected(self) -> int:
+        return max(0, telemetry.counter("ga.hangs_detected").value
+                   - self._hangs_base)
+
+    @property
+    def restarts(self) -> int:
+        return max(0, telemetry.counter("ga.evaluator_restarts").value
+                   - self._restarts_base)
+
+    def _note_hang(self, kind: str, wait: float) -> None:
+        """One detected hang: instance last-hang fields, registry
+        counter/gauges, and a journal event — the drill-facing record
+        that a hung evaluator was caught, how, and how fast."""
+        self.last_hang_kind = kind
+        self.last_hang_wait = wait
+        telemetry.counter("ga.hangs_detected").inc()
+        telemetry.gauge("ga.last_hang_wait").set(round(wait, 3))
+        telemetry.event("ga.hang_detected", kind=kind,
+                        wait=round(wait, 3))
+
+    def _begin_generation(self) -> None:
+        """Reset the per-generation hang descriptors.  Without this,
+        ``last_hang_kind``/``last_hang_wait`` kept describing a hang
+        from generations ago and drill telemetry attributed it to the
+        current one (cumulative counts live in the registry and are
+        untouched)."""
+        self.last_hang_kind = None
+        self.last_hang_wait = None
 
     # -- evaluator lifecycle ------------------------------------------
 
@@ -155,9 +195,10 @@ class ChipEvaluatorPool(Logger):
         """Restart after a death/hang, with exponential backoff +
         deterministic jitter once restarts come consecutively (a
         crash-looping evaluator must not storm the host)."""
-        self.restarts += 1
+        telemetry.counter("ga.evaluator_restarts").inc()
         self._consecutive_restarts += 1
         n = self._consecutive_restarts
+        telemetry.event("ga.evaluator_restart", consecutive=n)
         if n > 1:
             delay = min(self.restart_backoff_cap,
                         self.restart_backoff * (2.0 ** (n - 2)))
@@ -203,6 +244,22 @@ class ChipEvaluatorPool(Logger):
             self._proc.kill()
             self._proc.wait(timeout=10)
         self._proc = None
+        self._adopt_child_metrics()
+
+    def _adopt_child_metrics(self) -> None:
+        """Fold a dead/closed evaluator's metrics snapshot into this
+        process's registry (once per child pid), so the GA run reports
+        ONE aggregate view: the child's fused-step and evaluator-side
+        numbers land next to the pool's own supervision counters.  The
+        serve loop flushes after every job, so even a kill -9'd child
+        leaves a snapshot at most one genome stale."""
+        pid = (self.hello or {}).get("pid")
+        if not pid or pid in self._adopted_pids:
+            return
+        if telemetry.adopt_child_snapshot(pid):
+            self._adopted_pids.add(pid)
+            self.debug("merged evaluator pid %s telemetry snapshot",
+                       pid)
 
     def _read_stdout(self, proc, lines) -> None:
         for line in proc.stdout:
@@ -293,6 +350,7 @@ class ChipEvaluatorPool(Logger):
         ema = self.genome_duration_ema
         self.genome_duration_ema = dt if ema is None \
             else 0.7 * ema + 0.3 * dt
+        telemetry.histogram("ga.genome_seconds").record(dt)
 
     def evaluate_many(self, values_list: List[Dict[str, Any]]) \
             -> List[float]:
@@ -308,6 +366,7 @@ class ChipEvaluatorPool(Logger):
         scored inf.  ``max_barren_restarts`` consecutive restarts that
         resolve nothing mean the evaluator itself is broken: the
         remainder scores inf rather than restart-looping forever."""
+        self._begin_generation()
         if self._proc is None or self._proc.poll() is not None:
             self.start()
         jobs = self._prep_jobs(values_list)
@@ -336,6 +395,8 @@ class ChipEvaluatorPool(Logger):
                 # now the gene is the prime suspect: score it inf
                 pending.pop(0)
                 fits[head["id"]] = float("inf")
+                telemetry.counter("ga.genomes_lost").inc()
+                telemetry.event("ga.genome_lost", job=head["id"])
                 self.warning(
                     "evaluator lost genome %s twice (%s); scoring inf,"
                     " restarting for %d remaining", head["id"],
@@ -345,6 +406,8 @@ class ChipEvaluatorPool(Logger):
                 # its own accord — give the innocent-until-proven
                 # genome one retry on the fresh evaluator
                 retried.add(head["id"])
+                telemetry.counter("ga.genome_retries").inc()
+                telemetry.event("ga.genome_retry", job=head["id"])
                 self.warning(
                     "evaluator lost genome %s in flight; "
                     "retrying it once on a fresh evaluator",
@@ -369,6 +432,7 @@ class ChipEvaluatorPool(Logger):
         gets one restart+retry of the whole cohort; an evaluator-side
         error raises so the GeneticOptimizer falls back to the
         per-genome oracle."""
+        self._begin_generation()
         if self._proc is None or self._proc.poll() is not None:
             self.start()
         jobs = self._prep_jobs(values_list)
@@ -422,9 +486,7 @@ class ChipEvaluatorPool(Logger):
             if self.heartbeat_deadline:
                 hb_left = last_activity + self.heartbeat_deadline - now
                 if hb_left <= 0:
-                    self.hangs_detected += 1
-                    self.last_hang_kind = "heartbeat"
-                    self.last_hang_wait = now - last_activity
+                    self._note_hang("heartbeat", now - last_activity)
                     self.warning(
                         "evaluator silent for %.1fs during a cohort "
                         "(heartbeat deadline %.1fs) — declaring hung",
@@ -491,18 +553,14 @@ class ChipEvaluatorPool(Logger):
             # timeout slice expired: check the real deadlines
             if self.heartbeat_deadline and \
                     now - last_activity >= self.heartbeat_deadline:
-                self.hangs_detected += 1
-                self.last_hang_kind = "heartbeat"
-                self.last_hang_wait = now - last_activity
+                self._note_hang("heartbeat", now - last_activity)
                 self.warning(
                     "evaluator silent for %.1fs (heartbeat deadline "
                     "%.1fs) — declaring hung, replacing",
                     now - last_activity, self.heartbeat_deadline)
                 return done
             if now - genome_start >= self._genome_deadline():
-                self.hangs_detected += 1
-                self.last_hang_kind = "genome_deadline"
-                self.last_hang_wait = now - genome_start
+                self._note_hang("genome_deadline", now - genome_start)
                 self.warning(
                     "genome in flight for %.1fs, over its deadline "
                     "%.1fs (duration EMA %.1fs) — declaring the "

@@ -312,7 +312,7 @@ def serve(args) -> int:
     import os
     import threading
 
-    from veles_tpu import faults
+    from veles_tpu import faults, telemetry
     from veles_tpu.backends import make_device
     from veles_tpu.config import root
     from veles_tpu.logger import setup_logging
@@ -343,6 +343,7 @@ def serve(args) -> int:
             print(json.dumps(obj), flush=True)
 
     emit(hello)
+    telemetry.flush()   # even a job-less child leaves a snapshot
 
     hb_state = {"job": None, "silent": False}
     hb_stop = threading.Event()
@@ -374,34 +375,49 @@ def serve(args) -> int:
         if "gen" in job:
             fault_ctx["gen"] = job["gen"]
         seq += 1
+        telemetry.counter("evaluator.jobs").inc()
         try:
-            hang = faults.fire("evaluator.hang", **fault_ctx)
-            if hang:
-                # a stall mid-genome: heartbeats keep flowing unless
-                # the drill asked for a fully wedged process (silent)
-                hb_state["silent"] = bool(hang.get("silent"))
-                faults.hang(float(hang.get("seconds", 3600.0)))
-                hb_state["silent"] = False
-            if "members" in job:
-                # cohort job: same-signature genomes trained as one
-                # population-batched dispatch chain (chunked to the
-                # HBM budget; bad members score inf individually)
-                result["fitnesses"] = _evaluate_cohort(
-                    workflow_file, config_files, overrides, pristine,
-                    args, job["members"],
-                    int(job.get("seed", args.seed)))
-            else:
-                _rebuild_root(pristine, config_files, overrides,
-                              job["values"])
-                result["fitness"] = _evaluate(
-                    workflow_file, args.backend,
-                    int(job.get("seed", args.seed)), args.verbose)
+            # the span is the child-side per-job record: its histogram
+            # (evaluator.job_seconds) and journal line ride the
+            # snapshot the parent pool merges after this process dies
+            with telemetry.span("evaluator.job_seconds", journal=True,
+                                job=job["id"],
+                                cohort=len(job.get("members", []))
+                                or None):
+                hang = faults.fire("evaluator.hang", **fault_ctx)
+                if hang:
+                    # a stall mid-genome: heartbeats keep flowing
+                    # unless the drill asked for a fully wedged
+                    # process (silent)
+                    hb_state["silent"] = bool(hang.get("silent"))
+                    faults.hang(float(hang.get("seconds", 3600.0)))
+                    hb_state["silent"] = False
+                if "members" in job:
+                    # cohort job: same-signature genomes trained as
+                    # one population-batched dispatch chain (chunked
+                    # to the HBM budget; bad members score inf
+                    # individually)
+                    result["fitnesses"] = _evaluate_cohort(
+                        workflow_file, config_files, overrides,
+                        pristine, args, job["members"],
+                        int(job.get("seed", args.seed)))
+                else:
+                    _rebuild_root(pristine, config_files, overrides,
+                                  job["values"])
+                    result["fitness"] = _evaluate(
+                        workflow_file, args.backend,
+                        int(job.get("seed", args.seed)), args.verbose)
         except KeyboardInterrupt:
             raise
         except BaseException as e:  # noqa: BLE001 — bad genes score
             # inf at the parent; the evaluator must outlive them
             result["error"] = f"{type(e).__name__}: {e}"
+            telemetry.counter("evaluator.job_errors").inc()
         hb_state["job"] = None
+        # flush BEFORE the result line: once the parent sees the
+        # result it may kill/merge at any time, and the snapshot must
+        # already include this job
+        telemetry.flush()
         if faults.fire("evaluator.garbage_line", **fault_ctx):
             # a torn protocol line (e.g. a crashing library printing
             # over stdout) — the pool must treat it as noise + proof

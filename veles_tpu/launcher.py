@@ -19,7 +19,7 @@ import os
 import sys
 from typing import Any, Optional
 
-from veles_tpu import prng
+from veles_tpu import prng, telemetry
 from veles_tpu.backends import Device, make_device
 from veles_tpu.config import root
 from veles_tpu.logger import Logger, setup_logging
@@ -167,7 +167,11 @@ class Launcher(Logger):
             path = os.path.join(
                 directory,
                 f"multihost_abort_pid{os.getpid()}.pickle.gz")
-            return save_workflow(self.workflow, path)
+            out = save_workflow(self.workflow, path)
+            telemetry.counter("multihost.emergency_snapshots").inc()
+            telemetry.event("multihost.emergency_snapshot", path=out)
+            telemetry.flush()   # os._exit follows — atexit never runs
+            return out
         except Exception as e:  # noqa: BLE001 — the abort must land
             self.warning("emergency snapshot failed: %s", e)
             return None
@@ -178,6 +182,8 @@ class Launcher(Logger):
         workflow state and exit with a distinctive code — the
         operator's restart-from-snapshot path, not a hang and not a
         lost run."""
+        telemetry.event("multihost.collective_failed",
+                        error=f"{type(exc).__name__}: {exc}")
         path = self._emergency_snapshot()
         self.error(
             "multihost collective failed (%s: %s) — peer death or "
@@ -244,12 +250,21 @@ class Launcher(Logger):
                 seq += 1
 
         def watch(peer: int) -> None:
+            import time as _time
             seq = 0
+            last = _time.monotonic()
             while not stop.is_set():
                 try:
                     client.blocking_key_value_get(
                         f"veles_hb/{peer}/{seq}",
                         int(deadline * 1000))
+                    now = _time.monotonic()
+                    # the freshest peer-liveness age the run observed
+                    # — obs_report's first read on a wedged slice
+                    telemetry.gauge(
+                        "multihost.peer_heartbeat_age").set(
+                        round(now - last, 3))
+                    last = now
                     seq += 1
                     continue
                 except Exception:  # noqa: BLE001 — timeout or error
@@ -291,6 +306,8 @@ class Launcher(Logger):
         with the clean abort code (never hangs, never waits for the
         coordination service's SIGABRT)."""
         import threading
+        telemetry.event("multihost.peer_death", peer=peer,
+                        deadline=deadline)
         self.error(
             "multihost peer %d missed its liveness deadline (%.1fs) — "
             "peer death/partition; writing a final snapshot and "

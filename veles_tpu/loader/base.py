@@ -20,11 +20,12 @@ shapes) and masking padded rows out of the loss/metrics via
 
 from __future__ import annotations
 
+import time
 from typing import Any, List, Optional
 
 import numpy as np
 
-from veles_tpu import prng
+from veles_tpu import prng, telemetry
 from veles_tpu.distributable import Distributable
 from veles_tpu.memory import Vector
 from veles_tpu.mutable import Bool
@@ -100,6 +101,9 @@ class Loader(Unit, Distributable):
         self._pos = 0
         self._class_cursor = 0              # index into _present_classes
         self._present_classes: List[int] = []
+        #: monotonic start of the epoch in flight (telemetry:
+        #: loader.epoch_seconds); process-local, reset on restore
+        self._epoch_t0 = None
 
     _unpicklable = Unit._unpicklable + (
         "_prefetch_pool", "_prefetch_future",
@@ -112,6 +116,8 @@ class Loader(Unit, Distributable):
         self.__dict__.setdefault("device_resident", True)
         self.__dict__.setdefault("prefetch_enabled", True)
         self.__dict__.setdefault("dequant", None)
+        # a pickled monotonic timestamp is another process's clock
+        self.__dict__["_epoch_t0"] = None
 
     # -- subclass contract --------------------------------------------
 
@@ -193,6 +199,8 @@ class Loader(Unit, Distributable):
     # -- the firing ----------------------------------------------------
 
     def run(self) -> None:
+        if self._epoch_t0 is None:   # first firing of a (resumed) run
+            self._epoch_t0 = time.monotonic()
         self.epoch_ended.set(False)
         self.last_minibatch.set(False)
         self.class_ended.set(False)
@@ -240,6 +248,15 @@ class Loader(Unit, Distributable):
             if self._class_cursor >= len(self._present_classes):
                 self.epoch_ended.set(True)
                 self.epoch_number += 1
+                if self._epoch_t0 is not None:
+                    dt = time.monotonic() - self._epoch_t0
+                    telemetry.histogram(
+                        "loader.epoch_seconds").record(dt)
+                    telemetry.counter("loader.epochs").inc()
+                    telemetry.event("loader.epoch",
+                                    epoch=self.epoch_number,
+                                    seconds=round(dt, 3))
+                self._epoch_t0 = time.monotonic()
                 self._reset_epoch()
         # by now next epoch's order exists, so the NEXT group is fully
         # determined — overlap its host assembly with device compute

@@ -201,6 +201,10 @@ class FullBatchLoader(Loader):
                 # fits but the device disagreed (fragmentation, other
                 # tenants) — stream superstep batches from host
                 # instead of dying at initialize
+                from veles_tpu import telemetry
+                telemetry.counter("device.oom_degraded").inc()
+                telemetry.event("device.oom_degraded",
+                                site="resident_dataset")
                 self.warning(
                     "dataset upload hit device OOM (%s) — falling "
                     "back to host streaming", e)

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from veles_tpu import telemetry
 from veles_tpu.loader.base import TEST, TRAIN, VALID
 from veles_tpu.loader.fullbatch import FullBatchLoader
 
@@ -174,7 +175,14 @@ class FileListImageLoader(FullBatchLoader):
         self.corrupt_indices.add(i)
         n_bad, n_all = len(self.corrupt_indices), max(len(self._paths),
                                                       1)
+        if new:
+            telemetry.counter("loader.corrupt_skipped").inc()
         if new and n_bad <= 5:
+            # the journal gate matches the warn gate: a dying disk
+            # must not flood the event stream (the counter keeps the
+            # full tally)
+            telemetry.event("loader.corrupt_file",
+                            path=self._paths[i], index=int(i))
             self.warning(
                 "corrupt image skipped (%d bad of %d): %s (%s: %s)%s",
                 n_bad, n_all, self._paths[i], type(exc).__name__, exc,
@@ -183,6 +191,8 @@ class FileListImageLoader(FullBatchLoader):
         allowed = max(1, int(self.corrupt_tolerance * n_all)) \
             if self.corrupt_tolerance > 0 else 0
         if n_bad > allowed:
+            telemetry.event("loader.corrupt_over_tolerance",
+                            bad=n_bad, total=n_all)
             raise RuntimeError(
                 f"{self.name}: {n_bad}/{n_all} files failed to decode "
                 f"— over the corrupt_tolerance="
@@ -195,17 +205,27 @@ class FileListImageLoader(FullBatchLoader):
     def _decode_batch(self, indices: np.ndarray) -> np.ndarray:
         """Decode rows for global ``indices``, fanning PIL decodes out
         over a thread pool (PIL releases the GIL around the codec)."""
+        import time
         indices = np.asarray(indices)
+        t0 = time.perf_counter()
         if len(indices) <= 4:
-            return np.stack([self._decode_one(i) for i in indices])
-        if self._decode_pool is None:
-            import os as _os
-            from concurrent.futures import ThreadPoolExecutor
-            n = self.decode_workers or min(_os.cpu_count() or 4, 16)
-            self._decode_pool = ThreadPoolExecutor(
-                n, thread_name_prefix=f"{self.name}-decode")
-        return np.stack(list(self._decode_pool.map(self._decode_one,
-                                                   indices)))
+            out = np.stack([self._decode_one(i) for i in indices])
+        else:
+            if self._decode_pool is None:
+                import os as _os
+                from concurrent.futures import ThreadPoolExecutor
+                n = self.decode_workers or min(_os.cpu_count() or 4,
+                                               16)
+                self._decode_pool = ThreadPoolExecutor(
+                    n, thread_name_prefix=f"{self.name}-decode")
+            out = np.stack(list(self._decode_pool.map(
+                self._decode_one, indices)))
+        if telemetry.enabled():
+            telemetry.histogram("loader.decode_seconds").record(
+                time.perf_counter() - t0)
+            telemetry.counter("loader.images_decoded").inc(
+                len(indices))
+        return out
 
     def assemble_rows(self, indices: np.ndarray):
         if self.original_data.mem is not None:

@@ -34,7 +34,7 @@ import numpy as np
 
 from veles_tpu.accelerated_units import AcceleratedUnit
 from veles_tpu.loader.base import TRAIN
-from veles_tpu import prng
+from veles_tpu import prng, telemetry
 
 
 class FusedStepRunner(AcceleratedUnit):
@@ -92,19 +92,37 @@ class FusedStepRunner(AcceleratedUnit):
         #: (bench.py): on a link-bound host a perfect pipeline spends
         #: ~all its wall here, and the remainder is framework overhead
         self.stream_transfer_seconds = 0.0
-        #: cumulative host->device bytes the streaming path shipped
-        #: (pixel batches + targets/labels) — the wire-format
-        #: accounting: divided by processed images it certifies what
-        #: the codec actually moved per sample (uint8 ingest must show
-        #: <= half the bf16 wire, a quarter of f32)
-        self.stream_transfer_bytes = 0
+        #: this runner's share of the process-wide
+        #: ``fused.stream_transfer_bytes`` registry counter — the ONE
+        #: write site (_run_streaming) increments both, so the
+        #: ``stream_transfer_bytes`` property keeps its per-runner
+        #: meaning while the registry carries the process aggregate
+        self._stream_bytes = 0
         #: times a streaming upload OOMed and recovered by draining
         #: the double-buffer (Faultline telemetry; see _run_streaming)
         self.stream_oom_retries = 0
+        #: which step kinds ("train"/"eval") have dispatched — the
+        #: first firing of each is the compile+execute sample and is
+        #: recorded apart from the steady-state dispatch histogram
+        self._dispatch_seen: set = set()
+        #: monotonic timestamp of the first firing (end-of-run
+        #: throughput/MFU summary, see _record_telemetry_summary)
+        self._first_run_ts = None
 
     _unpicklable = AcceleratedUnit._unpicklable + (
         "_train_step", "_eval_step", "_params", "_opt", "mesh",
         "_batch_sharding", "_acc", "_conf", "_inflight")
+
+    @property
+    def stream_transfer_bytes(self) -> int:
+        """Cumulative host->device bytes THIS runner's streaming path
+        shipped (pixel batches + targets/labels) — the wire-format
+        accounting: divided by processed images it certifies what the
+        codec actually moved per sample.  The former plain attribute
+        (and its ``__setstate__`` back-compat shim) is now a read-only
+        view over the accounting that also feeds the process-wide
+        ``fused.stream_transfer_bytes`` registry counter."""
+        return self._stream_bytes
 
     # -- pytree assembly ----------------------------------------------
 
@@ -452,6 +470,7 @@ class FusedStepRunner(AcceleratedUnit):
                 np.asarray(ld.minibatch_mask.map_read())[None])
 
     def run(self) -> None:
+        import time
         ld = self.loader
         self._ensure_params()
         if self._train_step is None:   # invalidated (e.g. a resize)
@@ -461,15 +480,47 @@ class FusedStepRunner(AcceleratedUnit):
         indices, mask = self._superstep_arrays()
         k = indices.shape[0]
         train = ld.minibatch_class == TRAIN
+        images = float(np.sum(mask))
         if train:
-            self.processed_images += float(np.sum(mask))
+            self.processed_images += images
         else:
-            self.processed_eval_images += float(np.sum(mask))
+            self.processed_eval_images += images
+        if self._first_run_ts is None:
+            self._first_run_ts = time.monotonic()
+        t0 = time.perf_counter()
         if self.streaming:
             self._run_streaming(ld, k, mask, train)
         else:
             self._run_resident(ld, k, indices, mask, train)
         self._rng_counter += k
+        self._record_dispatch("train" if train else "eval",
+                              time.perf_counter() - t0, k, images)
+
+    def _record_dispatch(self, kind: str, dt: float, k: int,
+                         images: float) -> None:
+        """Per-dispatch wall time into the registry.  The FIRST firing
+        of each step kind traces + compiles, so it lands in its own
+        gauge (and the journal) instead of polluting the steady-state
+        histogram the p50/p99 report reads.  Wall here is host-observed
+        submission time — on an async backend the device may still be
+        chewing; the honest end-to-end barrier remains the metric-carry
+        fetch (take_class_metrics / bench.py sync_images)."""
+        if not telemetry.enabled():
+            return
+        if kind not in self._dispatch_seen:
+            self._dispatch_seen.add(kind)
+            telemetry.gauge(
+                f"fused.first_{kind}_dispatch_seconds").set(dt)
+            telemetry.event("fused.first_dispatch", kind=kind,
+                            seconds=round(dt, 4),
+                            streaming=bool(self.streaming))
+        else:
+            telemetry.histogram(
+                f"fused.{kind}_dispatch_seconds").record(dt)
+        telemetry.counter("fused.dispatches").inc()
+        telemetry.counter(f"fused.{kind}_seconds").inc(dt)
+        telemetry.counter("fused.minibatches").inc(k)
+        telemetry.counter(f"fused.{kind}_images").inc(images)
 
     def _run_resident(self, ld, k, indices, mask, train: bool) -> None:
         dataset = ld.original_data.unmap()
@@ -521,7 +572,9 @@ class FusedStepRunner(AcceleratedUnit):
         # the codec actually ships per sample (uint8 ingest = 1
         # byte/pixel; bf16 = 2; f32 = 4) — bench.py and the codec
         # tests divide this by processed images
-        self.stream_transfer_bytes += int(xb.nbytes) + int(tb.nbytes)
+        n_wire = int(xb.nbytes) + int(tb.nbytes)
+        self._stream_bytes += n_wire
+        telemetry.counter("fused.stream_transfer_bytes").inc(n_wire)
         t_transfer = time.perf_counter()
         for attempt in (1, 2):
             try:
@@ -546,6 +599,8 @@ class FusedStepRunner(AcceleratedUnit):
                     "streaming upload hit device OOM (%s); draining "
                     "the in-flight double-buffer and retrying once", e)
                 self.stream_oom_retries += 1
+                telemetry.counter("fused.stream_oom_retries").inc()
+                telemetry.event("device.oom_retry", site="stream")
                 while self._inflight:
                     for buf in self._inflight.popleft():
                         buf.block_until_ready()
@@ -556,7 +611,10 @@ class FusedStepRunner(AcceleratedUnit):
         if len(self._inflight) > 2:
             for buf in self._inflight.popleft():
                 buf.block_until_ready()
-        self.stream_transfer_seconds += time.perf_counter() - t_transfer
+        dt_transfer = time.perf_counter() - t_transfer
+        self.stream_transfer_seconds += dt_transfer
+        telemetry.counter("fused.stream_transfer_seconds").inc(
+            dt_transfer)
         if train:
             self._params, self._opt, self._acc, self._conf = \
                 self._train_step(
@@ -606,8 +664,47 @@ class FusedStepRunner(AcceleratedUnit):
     # -- metric intake (Decision / zmq slave) --------------------------
 
     def stop(self) -> None:
+        self._record_telemetry_summary()
         self._inflight.clear()  # release the upload double-buffer
         super().stop()
+
+    def _record_telemetry_summary(self) -> None:
+        """End-of-run throughput gauges: wall-clock images/sec since
+        the first firing and — where the device's peak is known —
+        achieved MFU via profiling.py.  Wall includes host time between
+        dispatches, so this is the run's DELIVERED rate (a lower bound
+        on engine efficiency), the number an operator reads off
+        obs_report; bench.py's barriered windows remain the measured
+        engine rate."""
+        import time
+        if self._first_run_ts is None or not telemetry.enabled():
+            return
+        elapsed = time.monotonic() - self._first_run_ts
+        self._first_run_ts = None   # stop() may run more than once
+        images = self.processed_images
+        if elapsed <= 0 or images <= 0:
+            return
+        rate = images / elapsed
+        telemetry.gauge("fused.train_images_per_sec_wall").set(
+            round(rate, 3))
+        try:
+            from veles_tpu import profiling
+            flops = profiling.model_flops_per_sample(
+                self.forwards)["train"]
+            telemetry.gauge("fused.train_gflops_per_image").set(
+                round(flops / 1e9, 4))
+            jdev = getattr(self.device, "jax_device", None)
+            u = profiling.mfu(rate, flops, jdev) \
+                if jdev is not None else None
+            if u is not None:
+                telemetry.gauge("fused.mfu").set(round(u, 5))
+            telemetry.event(
+                "fused.summary", images=images,
+                images_per_sec_wall=round(rate, 2),
+                mfu=round(u, 5) if u is not None else None,
+                streaming=bool(self.streaming))
+        except Exception:  # noqa: BLE001 — summary is best-effort
+            pass
 
     def release_device_state(self, sync: bool = False) -> None:
         """Drop every device buffer this runner (and its forwards)
@@ -693,7 +790,15 @@ class FusedStepRunner(AcceleratedUnit):
 
     def __getstate__(self) -> dict:
         self.sync_params_to_vectors()
-        return super().__getstate__()
+        d = super().__getstate__()
+        # the wire-byte count snapshots under its public name (older
+        # snapshots carried the plain attribute); dispatch bookkeeping
+        # is process-local
+        d.pop("_stream_bytes", None)
+        d.pop("_dispatch_seen", None)
+        d.pop("_first_run_ts", None)
+        d["stream_transfer_bytes"] = self.stream_transfer_bytes
+        return d
 
     def __setstate__(self, state: dict) -> None:
         super().__setstate__(state)
@@ -704,8 +809,14 @@ class FusedStepRunner(AcceleratedUnit):
         self.__dict__.setdefault("lr_rates", None)
         self.__dict__.setdefault("streaming", False)
         self.__dict__.setdefault("stream_transfer_seconds", 0.0)
-        self.__dict__.setdefault("stream_transfer_bytes", 0)
         self.__dict__.setdefault("stream_oom_retries", 0)
+        # the snapshotted byte count (0 for pre-field snapshots):
+        # `stream_transfer_bytes` is a property now, so the plain dict
+        # entry the pickle carried must be consumed here
+        restored = self.__dict__.pop("stream_transfer_bytes", 0) or 0
+        self._stream_bytes = int(restored)
+        self._dispatch_seen = set()
+        self._first_run_ts = None
         from collections import deque
         if self.__dict__.get("_inflight") is None:  # dropped by pickle
             self._inflight = deque()
@@ -830,26 +941,62 @@ class EnsembleEvalEngine:
     def predict_proba(self, x: np.ndarray) -> np.ndarray:
         """Mean member probabilities for a host batch — one vmapped
         dispatch (a distinct batch shape compiles once)."""
+        import time
+        t0 = time.perf_counter()
         xb = self.device.put(np.asarray(x, np.float32))
-        return np.asarray(self._predict(self._params, xb))
+        out = np.asarray(self._predict(self._params, xb))
+        self._record_dispatch(time.perf_counter() - t0, len(out))
+        return out
+
+    def _record_dispatch(self, dt: float, images: int) -> None:
+        """One fetched (host-synchronous) ensemble dispatch: the
+        np.asarray/acc fetch IS the barrier, so this wall time covers
+        upload + the full vmapped member sweep."""
+        if not telemetry.enabled():
+            return
+        telemetry.histogram("ensemble.dispatch_seconds").record(dt)
+        telemetry.counter("ensemble.chunks").inc()
+        telemetry.counter("ensemble.seconds").inc(dt)
+        telemetry.counter("ensemble.images").inc(images)
+        telemetry.counter("ensemble.member_images").inc(
+            images * self.n_members)
 
     def error_pct(self, x: np.ndarray, labels: np.ndarray,
                   chunk: int = 256) -> float:
         """Classification error % of the averaged ensemble over a host
         split, chunked at a fixed shape with a donated [wrong, count]
         device carry."""
+        import time
         x = np.asarray(x, np.float32)
         labels = np.asarray(labels, np.int32)
         chunk = max(1, min(chunk, len(x)))
         acc = self.device.zeros(2, np.float32)
+        t0 = time.perf_counter()
+        n_chunks = 0
         for i in range(0, len(x), chunk):
             xb, lb, mask = _pad_chunk(x[i:i + chunk],
                                       labels[i:i + chunk], chunk)
             acc = self._score(self._params, acc, self.device.put(xb),
                               self.device.put(lb),
                               self.device.put(mask))
+            n_chunks += 1
         acc = np.asarray(acc)
+        self._record_score(time.perf_counter() - t0, n_chunks, len(x))
         return 100.0 * float(acc[0]) / max(float(acc[1]), 1.0)
+
+    def _record_score(self, dt: float, chunks: int,
+                      images: int) -> None:
+        """One whole scoring pass (chunks are dispatched async; the
+        donated-carry fetch at the end is the sync, so only the
+        pass-level wall is honest)."""
+        if not telemetry.enabled():
+            return
+        telemetry.histogram("ensemble.score_seconds").record(dt)
+        telemetry.counter("ensemble.chunks").inc(chunks)
+        telemetry.counter("ensemble.seconds").inc(dt)
+        telemetry.counter("ensemble.images").inc(images)
+        telemetry.counter("ensemble.member_images").inc(
+            images * self.n_members)
 
     # -- resident path -------------------------------------------------
 
@@ -864,9 +1011,13 @@ class EnsembleEvalEngine:
     def predict_proba_resident(self, indices) -> np.ndarray:
         if self._dataset is None:
             raise RuntimeError("attach_dataset() first")
+        import time
+        t0 = time.perf_counter()
         idx = self.device.put(np.asarray(indices, np.int32))
-        return np.asarray(self._predict_resident(
+        out = np.asarray(self._predict_resident(
             self._params, self._dataset, idx))
+        self._record_dispatch(time.perf_counter() - t0, len(out))
+        return out
 
     def error_pct_resident(self, n: Optional[int] = None,
                            chunk: int = 256) -> float:
@@ -874,9 +1025,12 @@ class EnsembleEvalEngine:
         gathered on device — zero pixel re-upload per call."""
         if self._dataset is None or self._labels is None:
             raise RuntimeError("attach_dataset(x, labels) first")
+        import time
         total = int(self._dataset.shape[0]) if n is None else int(n)
         chunk = max(1, min(chunk, total))
         acc = self.device.zeros(2, np.float32)
+        t0 = time.perf_counter()
+        n_chunks = 0
         for i in range(0, total, chunk):
             idx = np.arange(i, min(i + chunk, total), dtype=np.int32)
             mask = np.ones(chunk, np.float32)
@@ -886,7 +1040,9 @@ class EnsembleEvalEngine:
             acc = self._score_resident(
                 self._params, acc, self._dataset, self._labels,
                 self.device.put(idx), self.device.put(mask))
+            n_chunks += 1
         acc = np.asarray(acc)
+        self._record_score(time.perf_counter() - t0, n_chunks, total)
         return 100.0 * float(acc[0]) / max(float(acc[1]), 1.0)
 
     def release(self) -> None:
@@ -1199,6 +1355,13 @@ class PopulationTrainEngine:
         from veles_tpu import faults
         from veles_tpu.loader.base import TRAIN, VALID
 
+        with telemetry.span("ga.cohort_train", journal=True,
+                            members=self.n_members):
+            telemetry.counter("ga.cohorts").inc()
+            telemetry.counter("ga.cohort_members").inc(self.n_members)
+            return self._run_inner(faults, TRAIN, VALID)
+
+    def _run_inner(self, faults, TRAIN, VALID) -> np.ndarray:
         if faults.fire("device.oom_on_put", site="cohort",
                        members=self.n_members):
             # surfaces exactly like a real cohort OOM: the serve-mode

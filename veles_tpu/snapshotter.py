@@ -36,7 +36,7 @@ import time
 import zlib
 from typing import Any, List, Optional
 
-from veles_tpu import faults, prng
+from veles_tpu import faults, prng, telemetry
 from veles_tpu.units import Unit
 
 _OPENERS = {".gz": gzip.open, ".bz2": bz2.open, ".xz": lzma.open,
@@ -66,6 +66,7 @@ def save_workflow(workflow, path: str) -> str:
     suffix: .gz/.bz2/.xz) inside a CRC32 envelope, via a pid-unique
     temp file + atomic ``os.replace`` — two concurrent writers (e.g. a
     Snapshotter next to a manual save) can never tear each other."""
+    t0 = time.perf_counter()
     payload = {
         "format": 2,
         "workflow": workflow,
@@ -92,6 +93,11 @@ def save_workflow(workflow, path: str) -> str:
         except OSError:
             pass
         raise
+    dt = time.perf_counter() - t0
+    telemetry.histogram("snapshot.save_seconds").record(dt)
+    telemetry.counter("snapshot.saves").inc()
+    telemetry.event("snapshot.save", path=os.path.basename(path),
+                    bytes=len(blob), seconds=round(dt, 3))
     return path
 
 
@@ -171,6 +177,7 @@ def load_workflow(path: str, fallback: bool = False):
     corrupt snapshot must never silently become a fresh start."""
     import logging
     log = logging.getLogger("veles_tpu.snapshotter")
+    t0 = time.perf_counter()
     try:
         payload = _read_payload(path)
     except SnapshotCorruptError as e:
@@ -186,11 +193,17 @@ def load_workflow(path: str, fallback: bool = False):
                 log.warning("predecessor %s also corrupt (%s)",
                             cand, e2)
                 continue
+            telemetry.counter("snapshot.fallbacks").inc()
+            telemetry.event("snapshot.fallback", corrupt=path,
+                            used=cand)
             log.warning("resuming from intact predecessor %s "
                         "instead of corrupt %s", cand, path)
             break
         if payload is None:
+            telemetry.event("snapshot.unrecoverable", path=path)
             raise
+    telemetry.histogram("snapshot.load_seconds").record(
+        time.perf_counter() - t0)
     prng.restore_state(payload["prng"])
     return payload["workflow"]
 

@@ -139,6 +139,14 @@ class Workflow(Container):
 
     def on_workflow_finished(self) -> None:
         self.report_timings()
+        # end-of-run telemetry summary (images/sec + achieved MFU
+        # gauges): the CLI standalone path finishes run() without ever
+        # calling stop(), so the fused runner's summary fires here too
+        # (idempotent — the runner gates on its first-firing timestamp)
+        fused = getattr(self, "fused", None)
+        if fused is not None and \
+                hasattr(fused, "_record_telemetry_summary"):
+            fused._record_telemetry_summary()
 
     def report_timings(self) -> None:
         """Per-unit wall-time table (reference: end-of-run unit timing)."""
