@@ -358,8 +358,10 @@ def fault_drill_metric(phase):
     """Run the Faultline chaos drill (scripts/chaos_drill.py) as a
     recorded phase: the full fault matrix — evaluator hang + garbage
     line, torn snapshot, corrupt GA checkpoint, corrupt stream files,
-    device OOM, multihost peer death — injected on CPU and recovered
-    from, with per-fault recovery seconds.  Robustness gets a measured
+    device OOM, multihost peer death, SIGTERM preemption -> graceful
+    stop -> supervisor resume, SIGKILLed GA -> checkpoint resume —
+    injected on CPU and recovered from, with per-fault recovery
+    seconds.  Robustness gets a measured
     trajectory in BENCH_r* exactly like performance does.  A
     subprocess (CPU-pinned) because this process's jax client belongs
     to the chip."""
@@ -393,6 +395,18 @@ def fault_drill_metric(phase):
             if r["fault"] == "evaluator.hang_and_garbage" and r["ok"]:
                 out["fault_drill_hang_detect_sec"] = \
                     r.get("hang_detect_sec")
+            # Phoenix resume fields: SIGTERM -> final snapshot inside
+            # the grace deadline -> supervisor auto-resume, trajectory
+            # f32-exact vs the uninterrupted oracle (plus the GA
+            # SIGKILL drill's downtime) — robustness of RESUME gets a
+            # measured trajectory in BENCH_r*, like recovery did
+            if r["fault"] == "preempt.sigterm_resume" and r["ok"]:
+                out["preempt_snapshot_sec"] = \
+                    r.get("preempt_snapshot_sec")
+                out["resume_downtime_sec"] = \
+                    r.get("resume_downtime_sec")
+                out["resume_trajectory_match"] = \
+                    r.get("trajectory_match")
         phase(f"fault drill: ok={out['fault_drill_ok']} "
               f"{out['fault_drill_recovery_sec']}")
         return out
@@ -1063,6 +1077,9 @@ def main() -> None:
         "fault_drill_hang_detect_sec": None,
         "fault_drill_failures": None,
         "fault_drill_journal_verified": None,
+        "preempt_snapshot_sec": None,
+        "resume_downtime_sec": None,
+        "resume_trajectory_match": None,
         "tpu_tests_passed": None,
         "tpu_tests_failed": None,
         "ensemble_members": None,

@@ -11,8 +11,12 @@ asserts the documented recovery: a hung evaluator is replaced within
 the heartbeat deadline, torn snapshots / GA checkpoints fall back to
 the newest intact predecessor, corrupt stream files are skipped and
 counted (and abort loudly past the tolerance), an OOMing upload
-degrades instead of dying, and a dying multihost peer aborts the
-survivors cleanly with a final snapshot.
+degrades instead of dying, a dying multihost peer aborts the
+survivors cleanly with a final snapshot, a SIGTERM (preemption
+notice) stops gracefully — final snapshot inside the grace deadline,
+exit 14, supervisor auto-resume, trajectory f32-exact vs the
+uninterrupted oracle — and a SIGKILLed GA run resumes from its
+per-generation checkpoint bit-identically.
 
 The last stdout line is one JSON record::
 
@@ -61,6 +65,25 @@ def assert_journal_event(name: str, since: int = 0) -> dict:
         f"no {name!r} event in the telemetry journal " \
         f"(have: {sorted({e['event'] for e in telemetry.recent_events()})})"
     return evs[-1]
+
+
+def journal_events_from_dir(mdir: str, name: str = None) -> list:
+    """Events from every ``journal-*.jsonl`` under ``mdir`` — the way
+    to verify what SUBPROCESSES (supervisor, launcher children,
+    multihost peers) reported; the in-process ring only mirrors this
+    process's journal."""
+    import glob
+    evs = []
+    for jf in glob.glob(os.path.join(mdir, "journal-*.jsonl")):
+        with open(jf) as f:
+            for line in f:
+                try:
+                    evs.append(json.loads(line))
+                except ValueError:
+                    pass
+    if name is not None:
+        evs = [e for e in evs if e.get("event") == name]
+    return sorted(evs, key=lambda e: e.get("ts", 0))
 
 
 def drill(fn):
@@ -437,28 +460,201 @@ def drill_multihost__peer_exit():
     assert "aborting cleanly" in err0, err0[-800:]
     snaps = []
     for root, _, files in os.walk(d):
-        snaps += [f for f in files if f.startswith("multihost_abort")]
+        # Phoenix named the emergency snapshot INTO the Snapshotter
+        # lineage (<prefix>_final_multihost-abort_pid<pid>...), so
+        # --snapshot/--supervise resume discovery finds it
+        snaps += [f for f in files if "_final_multihost" in f]
     assert snaps, "no final snapshot written by the survivor"
     # the survivor's journal (its own process wrote journal-<pid>.jsonl
     # into the shared metrics dir it inherited via $VELES_METRICS_DIR)
     # must carry the abort record — the drill verifies REPORTING, not
     # just recovery
     from veles_tpu import telemetry
-    ev_names = set()
     mdir = telemetry.metrics_dir()
-    if mdir:
-        import glob
-        for jf in glob.glob(os.path.join(mdir, "journal-*.jsonl")):
-            with open(jf) as f:
-                for line in f:
-                    try:
-                        ev_names.add(json.loads(line)["event"])
-                    except (ValueError, KeyError):
-                        pass
-    assert "multihost.emergency_snapshot" in ev_names, \
-        f"survivor journal lacks the abort record (saw {sorted(ev_names)})"
+    evs = journal_events_from_dir(mdir, "multihost.emergency_snapshot") \
+        if mdir else []
+    assert evs, "survivor journal lacks the abort record"
     return {"survivor_exit": rc0, "final_snapshot": snaps[0],
             "journal_event": "multihost.emergency_snapshot"}
+
+
+# -- Phoenix drills (preemption + supervisor) --------------------------
+
+_PHX_WF = """
+import json
+import os
+
+import numpy as np
+
+from veles_tpu import prng
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+
+def create_workflow(launcher):
+    prng.seed_all(1357)
+    train, valid, _ = synthetic_classification(
+        2400, 400, (8, 8, 1), n_classes=4, seed=7)
+    gd = {"learning_rate": 0.1, "gradient_moment": 0.9}
+    return StandardWorkflow(
+        loader_factory=lambda w: ArrayLoader(
+            w, train=train, valid=valid, minibatch_size=24,
+            name="loader"),
+        layers=[
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": 24}, "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": int(os.environ["PHX_EPOCHS"]),
+                         "fail_iterations": 10000},
+        snapshotter_config={"directory": os.environ["PHX_SNAP_DIR"],
+                            "prefix": "phx", "interval": 1000},
+        name="phx_wf")
+
+
+def run(launcher):
+    launcher.create_workflow(create_workflow)
+    launcher.initialize()
+    launcher.run()
+    w = launcher.workflow
+    hist = [[h["class"], int(h["n_err"]), float(h["loss"])]
+            for h in w.decision.history]
+    ws = float(np.abs(np.asarray(
+        w.forwards[0].weights.map_read()).astype(np.float64)).sum())
+    print(json.dumps({
+        "epochs": len([h for h in hist if h[0] == "validation"]),
+        "hist": hist, "wsum": ws}))
+"""
+
+
+def _phx_env(d, metrics, epochs, **extra):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PHX_SNAP_DIR": os.path.join(d, "snaps"),
+                "PHX_EPOCHS": str(epochs),
+                "VELES_METRICS_DIR": metrics})
+    env.pop("VELES_FAULTS", None)
+    env.pop("VELES_RESUME_MANIFEST", None)
+    env.update(extra)
+    return env
+
+
+def _last_json(out: str) -> dict:
+    return json.loads(out.strip().splitlines()[-1])
+
+
+def drill_preempt__sigterm_resume():
+    """The Phoenix headline: a real SIGTERM lands mid-training (the
+    injected preemption notice); the run must stop at the next
+    dispatch boundary, write a final snapshot into the Snapshotter
+    lineage INSIDE the grace deadline, and exit 14; the supervisor
+    must auto-resume it from that snapshot — and the completed
+    trajectory must match the uninterrupted oracle f32-exactly."""
+    import subprocess
+    d = tempfile.mkdtemp(prefix="chaos_preempt_")
+    wf = os.path.join(d, "wf.py")
+    with open(wf, "w") as f:
+        f.write(_PHX_WF)
+    epochs, grace = 200, 20.0
+
+    oracle = subprocess.run(
+        [sys.executable, "-m", "veles_tpu", "-b", "cpu", wf],
+        env=_phx_env(d, os.path.join(d, "m_oracle"), epochs),
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert oracle.returncode == 0, oracle.stderr[-800:]
+    ref = _last_json(oracle.stdout)
+    assert ref["epochs"] == epochs, ref["epochs"]
+
+    mdir = os.path.join(d, "m_supervised")
+    res = subprocess.run(
+        [sys.executable, "-m", "veles_tpu", "--supervise",
+         "-b", "cpu", wf],
+        env=_phx_env(
+            d, mdir, epochs,
+            VELES_FAULTS="preempt.sigterm@attempt=0&after=1.5",
+            VELES_PREEMPT_GRACE=str(grace)),
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert res.returncode == 0, \
+        f"supervised run rc={res.returncode}: {res.stderr[-800:]}"
+    got = _last_json(res.stdout)
+
+    # the preempted child left its final snapshot in the lineage
+    snaps = [f for f in os.listdir(os.path.join(d, "snaps"))
+             if f.startswith("phx_final_preempt")]
+    assert snaps, os.listdir(os.path.join(d, "snaps"))
+    # journal: requested -> final snapshot (inside grace, never the
+    # watchdog's hard path) -> supervisor resumed -> done
+    req = journal_events_from_dir(mdir, "preempt.requested")
+    fin = journal_events_from_dir(mdir, "preempt.final_snapshot")
+    assert req and fin, journal_events_from_dir(mdir)
+    assert not journal_events_from_dir(mdir,
+                                       "preempt.deadline_exceeded")
+    snapshot_sec = fin[-1]["ts"] - req[-1]["ts"]
+    assert 0 <= snapshot_sec <= grace, snapshot_sec
+    resumed = journal_events_from_dir(mdir, "supervisor.resumed")
+    assert resumed and resumed[-1]["source"] == "snapshot", resumed
+    assert journal_events_from_dir(mdir, "supervisor.done")
+
+    # trajectory parity: f32-exact on CPU, incl. the weight checksum
+    match = got["hist"] == ref["hist"] and got["wsum"] == ref["wsum"]
+    assert match, (got["epochs"], ref["epochs"], got["wsum"],
+                   ref["wsum"])
+    return {"journal_event": "preempt.final_snapshot",
+            "trajectory_match": True,
+            "preempt_snapshot_sec": round(snapshot_sec, 2),
+            "resume_downtime_sec": resumed[-1].get("downtime"),
+            "final_snapshot": snaps[0]}
+
+
+def drill_supervisor__sigkill_ga_resume():
+    """A GA run is SIGKILLed mid-generation (after the generation's
+    evaluations, before its checkpoint lands — the worst case); the
+    supervisor must resume it from the per-generation --ga-state
+    checkpoint and the finished run must be bit-identical to the
+    uninterrupted oracle (same best/fitness AND the same final
+    checkpoint file, RNG state included)."""
+    import subprocess
+    d = tempfile.mkdtemp(prefix="chaos_sigkill_ga_")
+    wf, cfg = _wine_ga_files(d)
+
+    def run_ga(state, metrics, fault=None):
+        env = _phx_env(d, metrics, 0)
+        if fault:
+            env["VELES_FAULTS"] = fault
+        cmd = [sys.executable, "-m", "veles_tpu"]
+        if fault:
+            cmd.append("--supervise")
+        cmd += ["--optimize", "5:2", "-b", "tpu-evaluator",
+                "--ga-workers", "2", "--ga-state", state, wf, cfg]
+        res = subprocess.run(cmd, env=env, capture_output=True,
+                             text=True, timeout=420, cwd=REPO)
+        assert res.returncode == 0, \
+            f"rc={res.returncode}: {res.stderr[-800:]}"
+        return _last_json(res.stdout)
+
+    ref = run_ga(os.path.join(d, "oracle.json"),
+                 os.path.join(d, "m_oracle"))
+    mdir = os.path.join(d, "m_supervised")
+    got = run_ga(os.path.join(d, "state.json"), mdir,
+                 fault="supervisor.child_crash@attempt=0&gen=2")
+    assert got == ref, (got, ref)
+    # the final checkpoints must be bit-identical too: population,
+    # fitnesses, history, and the GA RNG state all replayed exactly
+    with open(os.path.join(d, "oracle.json")) as f:
+        st_ref = json.load(f)
+    with open(os.path.join(d, "state.json")) as f:
+        st_got = json.load(f)
+    assert st_got == st_ref, "resumed GA checkpoint diverged"
+    restarts = journal_events_from_dir(mdir, "supervisor.restart")
+    assert restarts and restarts[-1]["kind"] == "crash", restarts
+    resumed = journal_events_from_dir(mdir, "supervisor.resumed")
+    assert resumed and resumed[-1]["source"] == "ga_state", resumed
+    assert journal_events_from_dir(mdir, "ga.resumed")
+    return {"journal_event": "supervisor.resumed",
+            "bit_identical_resume": True,
+            "resume_downtime_sec": resumed[-1].get("downtime")}
 
 
 DRILLS = [
@@ -469,6 +665,8 @@ DRILLS = [
     drill_device__oom_on_put_resident,
     drill_evaluator__hang_and_garbage,
     drill_multihost__peer_exit,
+    drill_preempt__sigterm_resume,
+    drill_supervisor__sigkill_ga_resume,
 ]
 
 

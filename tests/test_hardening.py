@@ -97,16 +97,27 @@ class TestMultihostGuard:
         launcher.init_multihost()
         assert calls == []
 
-    def test_refused_initialize_warns_not_crashes(self, monkeypatch):
-        """A RuntimeError from initialize (backend already up) must be
-        survivable — warn and continue single-process."""
+    def test_refused_initialize_fails_loudly(self, monkeypatch):
+        """A RuntimeError from initialize (backend already up) on a
+        --multihost launch must FAIL LOUDLY (a silent single-process
+        continuation would train 1/N of the data and checkpoint a
+        state no peer can join), journal ``multihost.init_refused``,
+        and continue solo only under VELES_MULTIHOST_ALLOW_SOLO=1."""
         import jax
 
-        from veles_tpu import launcher
+        from veles_tpu import launcher, telemetry
 
         def refuse(*a, **k):
             raise RuntimeError("must be called before any JAX calls")
         monkeypatch.setattr(jax.distributed, "initialize", refuse)
+        monkeypatch.setattr(launcher, "_multihost_initialized", False)
+        monkeypatch.delenv("VELES_MULTIHOST_ALLOW_SOLO", raising=False)
+        with pytest.raises(RuntimeError,
+                           match="VELES_MULTIHOST_ALLOW_SOLO"):
+            launcher.init_multihost()
+        assert telemetry.recent_events("multihost.init_refused")
+        # the explicit opt-in keeps the old continue-solo semantics
+        monkeypatch.setenv("VELES_MULTIHOST_ALLOW_SOLO", "1")
         monkeypatch.setattr(launcher, "_multihost_initialized", False)
         launcher.init_multihost()  # must not raise
         assert launcher._multihost_initialized

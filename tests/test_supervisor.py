@@ -1,0 +1,549 @@
+"""Phoenix: the exit-code contract, the run supervisor's
+interpretation of it (13/14 always resume and never charge the crash
+budget; crash-loops give up), flag-less resume-state discovery, the
+graceful-stop dispatch boundary, and a REAL subprocess
+SIGTERM -> final snapshot -> auto-resume round trip (CPU, bounded)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from veles_tpu import supervisor, telemetry
+from veles_tpu.supervisor import (EXIT_DONE, EXIT_MULTIHOST_ABORT,
+                                  EXIT_PREEMPTED, RESUME_CODES,
+                                  Supervisor, _normalize_rc)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_supervisor(tmp_path, script, **kw):
+    """A Supervisor over a stub ``python -c`` child (no jax import —
+    each spawn is milliseconds)."""
+    kw.setdefault("restart_backoff", 0.01)
+    kw.setdefault("restart_backoff_cap", 0.05)
+    return Supervisor([], command=[sys.executable, "-c", script],
+                      manifest_path=str(tmp_path / "manifest.json"),
+                      **kw)
+
+
+def counting_script(counter, codes):
+    """A stub child that exits ``codes[n]`` on its n-th spawn (sticky
+    on the last entry) and records spawn count in ``counter``."""
+    return (
+        "import os, sys\n"
+        f"p = {str(counter)!r}\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        f"codes = {codes!r}\n"
+        "sys.exit(codes[min(n, len(codes) - 1)])\n"
+    )
+
+
+def spawns(counter) -> int:
+    return int(open(counter).read()) if os.path.exists(counter) else 0
+
+
+class TestExitCodeContract:
+    def test_constants_pinned(self):
+        """The exit-code contract is API: 0 done, 13 multihost abort,
+        14 preempted — launcher and supervisor must agree, and only
+        13/14 resume without charging the crash budget."""
+        from veles_tpu.launcher import Launcher
+        assert EXIT_DONE == 0
+        assert Launcher.MULTIHOST_ABORT_EXIT == 13
+        assert Launcher.PREEMPT_EXIT == 14
+        assert EXIT_MULTIHOST_ABORT == Launcher.MULTIHOST_ABORT_EXIT
+        assert EXIT_PREEMPTED == Launcher.PREEMPT_EXIT
+        assert RESUME_CODES == frozenset((13, 14))
+
+    def test_signal_rc_normalized_to_shell_convention(self):
+        assert _normalize_rc(0) == 0
+        assert _normalize_rc(3) == 3
+        assert _normalize_rc(-9) == 137    # SIGKILL
+        assert _normalize_rc(-15) == 143   # SIGTERM
+
+    def test_done_exits_zero_no_restarts(self, tmp_path):
+        sup = make_supervisor(tmp_path, "raise SystemExit(0)")
+        assert sup.run() == 0
+        assert sup.restarts == 0
+        assert telemetry.recent_events("supervisor.done")
+
+    @pytest.mark.parametrize("code", sorted(RESUME_CODES))
+    def test_13_and_14_always_resume_without_charging_budget(
+            self, tmp_path, code):
+        """Three preempt/abort exits in a row with a crash budget of
+        ONE: if 13/14 charged the budget the supervisor would give up
+        after the first — it must instead resume every time and land
+        the final clean exit."""
+        counter = str(tmp_path / "count")
+        sup = make_supervisor(
+            tmp_path, counting_script(counter, [code, code, code, 0]),
+            max_crashes=1, crash_window=3600)
+        assert sup.run() == 0
+        assert spawns(counter) == 4
+        assert sup.restarts == 3
+        evs = telemetry.recent_events("supervisor.restart")
+        assert len(evs) == 3
+        kind = "preempt" if code == EXIT_PREEMPTED else \
+            "multihost_abort"
+        assert all(e["kind"] == kind and not e["budget_charged"]
+                   for e in evs)
+        assert telemetry.counter("supervisor.restarts").value == 3
+
+    def test_crash_resumes_then_succeeds(self, tmp_path):
+        """Other nonzero codes are crashes: resumed (budget charged)
+        as long as the budget holds."""
+        counter = str(tmp_path / "count")
+        sup = make_supervisor(
+            tmp_path, counting_script(counter, [3, 0]),
+            max_crashes=3, crash_window=3600)
+        assert sup.run() == 0
+        assert spawns(counter) == 2
+        ev = telemetry.recent_events("supervisor.restart")[-1]
+        assert ev["kind"] == "crash" and ev["budget_charged"]
+
+    def test_crash_loop_exhausts_budget_and_gives_up(self, tmp_path):
+        """The acceptance pin: N failures inside the window give up
+        LOUDLY — child exit code propagated, supervisor.giveup
+        journaled, exactly N spawns."""
+        counter = str(tmp_path / "count")
+        sup = make_supervisor(
+            tmp_path, counting_script(counter, [3]),
+            max_crashes=3, crash_window=3600)
+        assert sup.run() == 3
+        assert spawns(counter) == 3
+        ev = telemetry.recent_events("supervisor.giveup")[-1]
+        assert ev["rc"] == 3 and ev["crashes"] == 3
+
+    def test_signal_death_is_a_crash(self, tmp_path):
+        sup = make_supervisor(
+            tmp_path,
+            "import os, signal; os.kill(os.getpid(), signal.SIGKILL)",
+            max_crashes=2, crash_window=3600)
+        assert sup.run() == 137
+        ev = telemetry.recent_events("supervisor.giveup")[-1]
+        assert ev["rc"] == 137
+
+    def test_usage_error_gives_up_immediately(self, tmp_path):
+        """argparse errors (2) are deterministic — a restart loop
+        would fail identically forever."""
+        counter = str(tmp_path / "count")
+        sup = make_supervisor(tmp_path,
+                              counting_script(counter, [2]))
+        assert sup.run() == 2
+        assert spawns(counter) == 1
+        ev = telemetry.recent_events("supervisor.giveup")[-1]
+        assert ev["reason"] == "usage_error"
+
+    def test_backoff_shape_matches_pool(self, tmp_path):
+        """First restart immediate, then exponential with +-25%
+        deterministic jitter, capped — the pool.py shape."""
+        sup = make_supervisor(tmp_path, "raise SystemExit(0)",
+                              restart_backoff=0.5,
+                              restart_backoff_cap=4.0)
+        assert sup._backoff(1) == 0.0
+        for n, base in ((2, 0.5), (3, 1.0), (4, 2.0), (5, 4.0),
+                        (9, 4.0)):
+            d = sup._backoff(n)
+            assert 0.75 * base <= d <= 1.25 * base, (n, d)
+
+
+class TestResumeStateDiscovery:
+    def _lineage(self, tmp_path):
+        from veles_tpu.snapshotter import save_workflow
+        d = tmp_path / "snaps"
+        d.mkdir()
+        older = str(d / "run_epoch1.pickle.gz")
+        newest = str(d / "run_epoch2.pickle.gz")
+        save_workflow({"marker": 1}, older)
+        time.sleep(0.02)
+        save_workflow({"marker": 2}, newest)
+        return older, newest
+
+    def test_verify_snapshot_probes_without_unpickling(self, tmp_path):
+        from veles_tpu.faults import truncate_file
+        from veles_tpu.snapshotter import verify_snapshot
+        older, newest = self._lineage(tmp_path)
+        assert verify_snapshot(older) and verify_snapshot(newest)
+        truncate_file(newest)
+        assert not verify_snapshot(newest)
+        garbage = str(tmp_path / "g.pickle.gz")
+        with open(garbage, "wb") as f:
+            f.write(b"\x00" * 64)
+        assert not verify_snapshot(garbage)
+
+    def test_newest_intact_candidate_walks_lineage(self, tmp_path):
+        """The manifest points at the newest snapshot; when that one
+        is torn the supervisor walks siblings newest-first to the
+        newest INTACT candidate."""
+        from veles_tpu.faults import truncate_file
+        from veles_tpu.snapshotter import write_resume_manifest
+        older, newest = self._lineage(tmp_path)
+        manifest = str(tmp_path / "manifest.json")
+        os.environ["VELES_RESUME_MANIFEST"] = manifest
+        try:
+            write_resume_manifest(snapshot=newest)
+        finally:
+            del os.environ["VELES_RESUME_MANIFEST"]
+        sup = Supervisor([], manifest_path=manifest)
+        assert sup.newest_intact_snapshot() == newest
+        truncate_file(newest)
+        assert sup.newest_intact_snapshot() == older
+
+    def test_argv_rewritten_to_newest_intact(self, tmp_path):
+        from veles_tpu.snapshotter import write_resume_manifest
+        older, newest = self._lineage(tmp_path)
+        manifest = str(tmp_path / "manifest.json")
+        os.environ["VELES_RESUME_MANIFEST"] = manifest
+        try:
+            write_resume_manifest(snapshot=newest)
+        finally:
+            del os.environ["VELES_RESUME_MANIFEST"]
+        # an existing --snapshot value is REPLACED
+        sup = Supervisor(["--snapshot", older, "wf.py"],
+                         manifest_path=manifest)
+        argv = sup._argv_for_attempt(1, downtime=0.5)
+        assert argv == ["--snapshot", newest, "wf.py"]
+        ev = telemetry.recent_events("supervisor.resumed")[-1]
+        assert ev["source"] == "snapshot" and ev["state"] == newest
+        assert ev["downtime"] == 0.5
+        # no --snapshot flag: appended
+        sup2 = Supervisor(["wf.py"], manifest_path=manifest)
+        assert sup2._argv_for_attempt(1, None) == \
+            ["wf.py", "--snapshot", newest]
+        # attempt 0 (first spawn) never rewrites
+        assert sup._argv_for_attempt(0, None) == \
+            ["--snapshot", older, "wf.py"]
+
+    def test_ga_runs_resume_via_their_own_state_file(self, tmp_path):
+        """--optimize argv is left untouched (the child's --ga-state
+        resumes by itself); the manifest's ga_state is reported as the
+        resume source."""
+        from veles_tpu.snapshotter import write_resume_manifest
+        manifest = str(tmp_path / "manifest.json")
+        os.environ["VELES_RESUME_MANIFEST"] = manifest
+        try:
+            write_resume_manifest(ga_state=str(tmp_path / "ga.json"))
+        finally:
+            del os.environ["VELES_RESUME_MANIFEST"]
+        argv = ["--optimize", "4:2", "--ga-state",
+                str(tmp_path / "ga.json"), "wf.py"]
+        sup = Supervisor(list(argv), manifest_path=manifest)
+        assert sup._argv_for_attempt(1, None) == argv
+        ev = telemetry.recent_events("supervisor.resumed")[-1]
+        assert ev["source"] == "ga_state"
+
+    def test_manifest_merges_fields(self, tmp_path):
+        """Snapshot and GA-state updates must not clobber each other —
+        one manifest records the whole run's resume state."""
+        from veles_tpu.snapshotter import (read_resume_manifest,
+                                           write_resume_manifest)
+        snap = str(tmp_path / "s" / "run_epoch1.pickle.gz")
+        os.makedirs(os.path.dirname(snap))
+        open(snap, "wb").close()
+        manifest = str(tmp_path / "manifest.json")
+        os.environ["VELES_RESUME_MANIFEST"] = manifest
+        try:
+            write_resume_manifest(snapshot=snap)
+            write_resume_manifest(ga_state=str(tmp_path / "ga.json"))
+        finally:
+            del os.environ["VELES_RESUME_MANIFEST"]
+        m = read_resume_manifest(manifest)
+        assert m["snapshot"] == snap
+        assert m["ga_state"] == str(tmp_path / "ga.json")
+        # the copy next to the snapshot exists too (operator resume)
+        sibling = read_resume_manifest(
+            os.path.join(os.path.dirname(snap),
+                         "resume_manifest.json"))
+        assert sibling and sibling["snapshot"] == snap
+
+
+def _tiny_workflow(max_epochs=6, snap_dir=None):
+    from veles_tpu import prng
+    from veles_tpu.datasets import synthetic_classification
+    from veles_tpu.loader import ArrayLoader
+    from veles_tpu.ops.standard_workflow import StandardWorkflow
+    prng.seed_all(1357)
+    train, valid, _ = synthetic_classification(
+        160, 40, (8, 8, 1), n_classes=4, seed=7)
+    gd = {"learning_rate": 0.1, "gradient_moment": 0.9}
+    snap_cfg = None if snap_dir is None else \
+        {"directory": str(snap_dir), "prefix": "phx",
+         "interval": 1000}
+    return StandardWorkflow(
+        loader_factory=lambda w: ArrayLoader(
+            w, train=train, valid=valid, minibatch_size=20,
+            name="loader"),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": max_epochs},
+        snapshotter_config=snap_cfg, name="phx_t")
+
+
+class TestGracefulStopBoundary:
+    def test_mid_run_stop_snapshot_resume_is_bit_identical(
+            self, tmp_path):
+        """request_stop() mid-epoch stops at the iteration boundary
+        (the Repeater), where a snapshot resumes EXACTLY: the
+        completed run matches the uninterrupted oracle bit for bit —
+        the property the SIGTERM drill's trajectory check rests on."""
+        from veles_tpu.backends import JaxDevice
+        from veles_tpu.snapshotter import load_workflow, save_workflow
+        ref = _tiny_workflow()
+        ref.initialize(device=JaxDevice(platform="cpu"))
+        ref.run()
+        ref_hist = [(h["class"], h["n_err"], float(h["loss"]))
+                    for h in ref.decision.history]
+        ref_w = np.asarray(
+            ref.forwards[0].weights.map_read()).copy()
+
+        w1 = _tiny_workflow()
+        w1.initialize(device=JaxDevice(platform="cpu"))
+        orig, calls = w1.loader.run, {"n": 0}
+
+        def counting():
+            orig()
+            calls["n"] += 1
+            if calls["n"] == 3:     # mid-run, mid-epoch
+                w1.request_stop()
+        w1.loader.run = counting
+        w1.run()
+        del w1.loader.__dict__["run"]
+        assert w1.stop_requested
+        epochs_done = len([h for h in w1.decision.history
+                           if h["class"] == "validation"])
+        assert 0 < epochs_done < 6   # genuinely interrupted
+        path = str(tmp_path / "stop.pickle.gz")
+        save_workflow(w1, path)
+
+        w2 = load_workflow(path)
+        # a graceful-stop snapshot must NOT carry the stale request
+        # into the resumed run
+        assert not w2.stop_requested
+        w2.initialize(device=JaxDevice(platform="cpu"))
+        w2.run()
+        got_hist = [(h["class"], h["n_err"], float(h["loss"]))
+                    for h in w2.decision.history]
+        got_w = np.asarray(w2.forwards[0].weights.map_read())
+        assert got_hist == ref_hist
+        assert np.array_equal(got_w, ref_w)
+
+    def test_run_clears_prior_stop_request(self):
+        from veles_tpu.backends import NumpyDevice
+        w = _tiny_workflow(max_epochs=1)
+        w.initialize(device=NumpyDevice())
+        w.request_stop()
+        w.run()   # the request predates run(): must not stop at fire 0
+        assert len([h for h in w.decision.history
+                    if h["class"] == "validation"]) == 1
+
+
+class TestFinalSnapshotLineage:
+    def test_final_snapshot_lands_in_lineage_with_manifest(
+            self, tmp_path, monkeypatch):
+        """final_snapshot(reason) names the file into the Snapshotter
+        prefix lineage (snapshot_candidates discovers it) and points
+        the resume manifest at it."""
+        from veles_tpu.backends import NumpyDevice
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.snapshotter import (read_resume_manifest,
+                                           snapshot_candidates)
+        w = _tiny_workflow(max_epochs=1, snap_dir=tmp_path)
+        w.initialize(device=NumpyDevice())
+        launcher = Launcher(backend="numpy")
+        launcher.workflow = w
+        out = launcher.final_snapshot("preempt-SIGTERM")
+        assert out is not None
+        base = os.path.basename(out)
+        assert base.startswith("phx_final_preempt-SIGTERM_pid")
+        # discovered from a hypothetical periodic sibling AND from the
+        # final snapshot itself (both stems collapse to "phx")
+        assert out in snapshot_candidates(
+            str(tmp_path / "phx_epoch9.pickle.gz"))
+        ev = telemetry.recent_events("preempt.final_snapshot")[-1]
+        assert ev["path"] == out
+        m = read_resume_manifest(
+            str(tmp_path / "resume_manifest.json"))
+        assert m["snapshot"] == out and m["reason"] == "preempt-SIGTERM"
+
+    def test_multihost_reason_keeps_emergency_event(self, tmp_path):
+        """The PR-6 _emergency_snapshot alias journals the multihost
+        event name the existing drills/report assert on."""
+        from veles_tpu.backends import NumpyDevice
+        from veles_tpu.launcher import Launcher
+        w = _tiny_workflow(max_epochs=1, snap_dir=tmp_path)
+        w.initialize(device=NumpyDevice())
+        launcher = Launcher(backend="numpy")
+        launcher.workflow = w
+        out = launcher._emergency_snapshot()
+        assert "_final_multihost-abort_pid" in os.path.basename(out)
+        ev = telemetry.recent_events(
+            "multihost.emergency_snapshot")[-1]
+        assert ev["path"] == out
+
+
+class TestGAGracefulStop:
+    def test_sigterm_stops_at_generation_boundary_exit_14(self):
+        """install_ga_stop + GeneticOptimizer(stop_check=...): a real
+        SIGTERM to this process halts breeding at the next generation
+        boundary and finish() returns 14."""
+        from veles_tpu import prng
+        from veles_tpu.genetics import GeneticOptimizer, Tune
+        from veles_tpu.supervisor import install_ga_stop
+        stop_check, finish = install_ga_stop(grace=60.0)
+        try:
+            assert not stop_check()
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.monotonic() + 5.0
+            while not stop_check() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert stop_check()
+            prng.seed_all(4242)
+            tunes = {"x": Tune(5.0, -10.0, 10.0)}
+            opt = GeneticOptimizer(
+                lambda v: (v["x"] - 2.0) ** 2, tunes, population=4,
+                generations=5, stop_check=stop_check)
+            opt.run()
+            # initial population evaluated, then the loop halted at
+            # its first boundary: exactly one (final) history entry
+            assert len(opt.history) == 1
+            assert telemetry.recent_events("preempt.ga_stop")
+        finally:
+            code = finish()
+        assert code == EXIT_PREEMPTED
+        assert telemetry.recent_events("preempt.ga_exit")
+
+
+class TestChildCrashFault:
+    def test_supervisor_child_crash_is_a_real_sigkill(self):
+        code = (
+            "from veles_tpu import faults\n"
+            "faults.arm('supervisor.child_crash@attempt=0')\n"
+            "faults.maybe_inject_child_crash(attempt='0')\n"
+            "print('survived')\n"
+        )
+        env = dict(os.environ)
+        env.pop("VELES_FAULTS", None)
+        r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                           env=env, capture_output=True, text=True,
+                           timeout=60)
+        assert r.returncode == -signal.SIGKILL, (r.returncode,
+                                                 r.stdout)
+        # and the qualifier gate: attempt=1 must NOT crash
+        code2 = code.replace("maybe_inject_child_crash(attempt='0')",
+                             "maybe_inject_child_crash(attempt='1')")
+        r2 = subprocess.run([sys.executable, "-c", code2], cwd=REPO,
+                            env=env, capture_output=True, text=True,
+                            timeout=60)
+        assert r2.returncode == 0 and "survived" in r2.stdout
+
+
+_RT_WF = """
+import json
+import os
+
+from veles_tpu import prng
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+
+def create_workflow(launcher):
+    prng.seed_all(1357)
+    train, valid, _ = synthetic_classification(
+        2400, 400, (8, 8, 1), n_classes=4, seed=7)
+    gd = {"learning_rate": 0.1, "gradient_moment": 0.9}
+    return StandardWorkflow(
+        loader_factory=lambda w: ArrayLoader(
+            w, train=train, valid=valid, minibatch_size=24,
+            name="loader"),
+        layers=[
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": 24}, "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": 150,
+                         "fail_iterations": 10000},
+        snapshotter_config={"directory": os.environ["RT_SNAP_DIR"],
+                            "prefix": "rt", "interval": 1000},
+        name="rt_wf")
+
+
+def run(launcher):
+    launcher.create_workflow(create_workflow)
+    launcher.initialize()
+    launcher.run()
+    w = launcher.workflow
+    epochs = len([h for h in w.decision.history
+                  if h["class"] == "validation"])
+    print(json.dumps({"rt_epochs": epochs}))
+"""
+
+
+class TestSigtermResumeRoundTrip:
+    def test_real_subprocess_sigterm_then_auto_resume(self, tmp_path):
+        """The bounded end-to-end pin (PR-6 hang-test style): a real
+        ``--supervise`` run is SIGTERMed mid-training by the injected
+        preemption fault; the child must write its final snapshot
+        inside the grace deadline and exit 14, and the supervisor must
+        auto-resume it to completion (exit 0, all epochs trained).
+        Full trajectory parity vs the oracle lives in the chaos drill;
+        this tier-1 test pins the mechanics in bounded time."""
+        wf = tmp_path / "wf.py"
+        wf.write_text(_RT_WF)
+        snaps = tmp_path / "snaps"
+        mdir = tmp_path / "metrics"
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "RT_SNAP_DIR": str(snaps),
+            "VELES_METRICS_DIR": str(mdir),
+            "VELES_PREEMPT_GRACE": "20",
+            "VELES_FAULTS": "preempt.sigterm@attempt=0&after=1.2",
+        })
+        env.pop("VELES_RESUME_MANIFEST", None)
+        res = subprocess.run(
+            [sys.executable, "-m", "veles_tpu", "--supervise",
+             "-b", "cpu", str(wf)],
+            env=env, capture_output=True, text=True, timeout=240,
+            cwd=REPO)
+        assert res.returncode == 0, \
+            (res.returncode, res.stderr[-1200:])
+        out = json.loads(res.stdout.strip().splitlines()[-1])
+        assert out["rt_epochs"] == 150
+        # the final snapshot landed in the lineage
+        assert any(f.startswith("rt_final_preempt-SIGTERM")
+                   for f in os.listdir(snaps)), os.listdir(snaps)
+        # journal: requested -> final snapshot inside grace (never the
+        # watchdog's hard path) -> supervisor resumed from it
+        events = []
+        for jf in os.listdir(mdir):
+            if jf.startswith("journal-"):
+                with open(mdir / jf) as f:
+                    events += [json.loads(line) for line in f]
+        names = [e["event"] for e in events]
+        assert "preempt.requested" in names
+        assert "preempt.final_snapshot" in names
+        assert "preempt.deadline_exceeded" not in names
+        req = [e for e in events
+               if e["event"] == "preempt.requested"][-1]
+        fin = [e for e in events
+               if e["event"] == "preempt.final_snapshot"][-1]
+        assert 0 <= fin["ts"] - req["ts"] <= 20.0
+        resumed = [e for e in events
+                   if e["event"] == "supervisor.resumed"][-1]
+        assert resumed["source"] == "snapshot"
+        assert "rt_final_preempt" in resumed["state"]
+        assert [e for e in events
+                if e["event"] == "supervisor.done"]

@@ -13,6 +13,7 @@ arguments override both.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from veles_tpu.config import parse_overrides
@@ -37,6 +38,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-s", "--seed", type=int, default=1234)
     p.add_argument("--snapshot", default=None,
                    help="resume from a snapshot file")
+    p.add_argument("--supervise", action="store_true",
+                   help="Phoenix run supervisor: spawn the run as a "
+                        "child and auto-resume it from the newest "
+                        "intact snapshot / GA state on crash (exit "
+                        "codes 13/14 always resume; crash-loops give "
+                        "up after $VELES_SUPERVISE_MAX_CRASHES "
+                        "failures inside $VELES_SUPERVISE_CRASH_"
+                        "WINDOW seconds).  See docs/guide.md "
+                        "'Operating long runs'")
     p.add_argument("--dp", type=int, default=None,
                    help="data-parallel ways over the device mesh")
     p.add_argument("--multihost", action="store_true",
@@ -133,6 +143,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if "--supervise" in argv:
+        # intercepted BEFORE argparse/config side effects: the
+        # supervisor process must stay light (no device, no jax) —
+        # the child re-parses the identical argv minus the flag
+        from veles_tpu import supervisor
+        return supervisor.run([a for a in argv if a != "--supervise"])
     # root.* overrides can appear anywhere; apply AFTER config files,
     # so collect them first but apply later.
     overrides = [a for a in argv if a.startswith("root.") and "=" in a]
@@ -384,6 +400,18 @@ def run_optimizer(args, workflow_file: str, config_files, overrides) \
     workers, worker_backend = _resolve_ga_execution(
         args.backend, _ga_worker_count(args))
 
+    # Phoenix graceful stop for the GA parent: SIGTERM/SIGINT stops at
+    # the next generation boundary (the --ga-state checkpoint is the
+    # resume point) and exits 14 so a supervisor resumes it.
+    # Installed after the cheap usage validation above so every path
+    # from here runs finish_preempt() (handler restoration) below.
+    from veles_tpu import faults
+    from veles_tpu.supervisor import install_ga_stop
+    stop_check, finish_preempt = install_ga_stop()
+    faults.maybe_inject_sigterm(
+        attempt=os.environ.get("VELES_SUPERVISE_ATTEMPT", "0"),
+        mode="ga")
+
     pool = None
     if worker_backend == "tpu-evaluator":
         from veles_tpu.genetics.pool import ChipEvaluatorPool
@@ -472,11 +500,22 @@ def run_optimizer(args, workflow_file: str, config_files, overrides) \
                                generations=gen,
                                evaluate_many=evaluate_many,
                                evaluate_cohort=evaluate_cohort,
-                               state_path=args.ga_state)
+                               state_path=args.ga_state,
+                               stop_check=stop_check)
         best, fitness = opt.run()
     finally:
         if pool is not None:
             pool.close()
+        # restore the signal handlers on EVERY path (exceptions
+        # included); the returned code matters only below
+        preempt_code = finish_preempt()
+    if preempt_code is not None:
+        # graceful stop: best-so-far reported, checkpoint on disk is
+        # the resume point — exit 14 so a supervisor resumes, never
+        # "done"
+        print(json.dumps({"best": best, "fitness": fitness,
+                          "preempted": True}))
+        return preempt_code
     import math
     if not math.isfinite(fitness):
         print("--optimize: every evaluation failed (fitness inf); "

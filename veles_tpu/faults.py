@@ -43,6 +43,15 @@ Registered points (the call sites document their context keys):
                             cohort)
 ``multihost.peer_exit``     this process hard-exits after multihost
                             init (``process``; knob: ``after`` secs)
+``preempt.sigterm``         this process sends ITSELF a real SIGTERM
+                            (``attempt``/``mode``; knob: ``after``
+                            secs) — rehearses a preemption notice; the
+                            graceful-stop path must snapshot and exit
+                            14 inside the grace deadline
+``supervisor.child_crash``  this process hard-dies via SIGKILL
+                            (``attempt``/``gen``/``site``) — rehearses
+                            an unannounced crash the supervisor must
+                            resume from the newest intact state
 ==========================  ==========================================
 
 Determinism: the registry carries no clock and no global RNG — an
@@ -72,6 +81,8 @@ POINTS = frozenset((
     "checkpoint.corrupt",
     "device.oom_on_put",
     "multihost.peer_exit",
+    "preempt.sigterm",
+    "supervisor.child_crash",
 ))
 
 _log = logging.getLogger("veles_tpu.faults")
@@ -218,6 +229,44 @@ def hang(seconds: float = 3600.0) -> None:
     deadline = time.monotonic() + seconds
     while time.monotonic() < deadline:
         time.sleep(min(1.0, max(0.0, deadline - time.monotonic())))
+
+
+def maybe_inject_sigterm(**ctx: Any) -> None:
+    """Faultline ``preempt.sigterm``: deliver a REAL SIGTERM to this
+    process (after ``after`` seconds on a timer thread) so drills can
+    rehearse a preemption notice end to end — the installed
+    graceful-stop handler must snapshot and exit 14 within
+    ``$VELES_PREEMPT_GRACE``.  Call sites pass ``attempt`` (the
+    supervisor's ``$VELES_SUPERVISE_ATTEMPT``) so a resumed child is
+    not re-preempted."""
+    f = fire("preempt.sigterm", **ctx)
+    if not f:
+        return
+    import signal
+    import threading
+    import time as _time
+    delay = float(f.get("after", 0.0))
+
+    def _term() -> None:
+        if delay > 0:
+            _time.sleep(delay)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    threading.Thread(target=_term, daemon=True,
+                     name="fault-preempt-sigterm").start()
+
+
+def maybe_inject_child_crash(**ctx: Any) -> None:
+    """Faultline ``supervisor.child_crash``: hard-kill this process
+    with SIGKILL — no handlers, no snapshot, no atexit; the supervisor
+    must resume the run from the newest intact snapshot / GA
+    checkpoint.  Call sites pass ``attempt``/``gen`` so the drill can
+    target exactly one crash."""
+    if fire("supervisor.child_crash", **ctx):
+        import signal
+        import sys as _sys
+        _sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def truncate_file(path: str, keep_fraction: float = 0.5) -> None:

@@ -133,7 +133,9 @@ class GeneticOptimizer(Logger):
                      [List[Dict[str, Any]]], List[float]]] = None,
                  evaluate_cohort: Optional[Callable[
                      [List[Dict[str, Any]]], List[float]]] = None,
-                 state_path: Optional[str] = None) -> None:
+                 state_path: Optional[str] = None,
+                 stop_check: Optional[Callable[[], bool]] = None) \
+            -> None:
         if not tunes:
             raise ValueError("no Tune(...) markers found to optimize")
         self.evaluate = evaluate
@@ -153,6 +155,10 @@ class GeneticOptimizer(Logger):
         #: it exists (reference parity: Genetics "spawns many workflow
         #: runs" and long GA runs must survive restarts)
         self.state_path = state_path
+        #: Phoenix graceful stop: a callable polled at each generation
+        #: boundary; True = stop breeding now and return the best so
+        #: far (the checkpoint already on disk is the resume point)
+        self._stop_check = stop_check
         self.tunes = tunes
         self.paths = sorted(tunes)
         self.population = max(population, 2 + elite)
@@ -258,6 +264,15 @@ class GeneticOptimizer(Logger):
         if dt > 0:
             self.info("evaluated %d genomes in %.1fs (%.2f genomes/s)",
                       len(genomes), dt, len(genomes) / dt)
+        # Faultline supervisor.child_crash: hard-die HERE — after the
+        # generation's real work, before its checkpoint lands — the
+        # worst-case crash point the supervisor must resume from
+        # (the re-evaluated generation is bit-identical: the restored
+        # RNG replays the same breeding)
+        from veles_tpu import faults
+        faults.maybe_inject_child_crash(
+            gen=gen, site="ga_generation",
+            attempt=os.environ.get("VELES_SUPERVISE_ATTEMPT", "0"))
         return fits
 
     def _fitness_many_inner(self, genomes: np.ndarray) -> np.ndarray:
@@ -381,6 +396,10 @@ class GeneticOptimizer(Logger):
             except OSError:
                 pass
             raise
+        # Phoenix: keep the supervisor's flag-less resume pointer
+        # current (records the GA state path + metrics dir)
+        from veles_tpu.snapshotter import write_resume_manifest
+        write_resume_manifest(ga_state=self.state_path)
 
     @staticmethod
     def _read_state_file(path: str) -> dict:
@@ -474,6 +493,8 @@ class GeneticOptimizer(Logger):
         resumed = self._load_state()
         if resumed is not None:
             start_gen, pop, fits = resumed
+            telemetry.event("ga.resumed", generation=start_gen,
+                            state=self.state_path)
             self.info("resumed GA at generation %d from %s",
                       start_gen, self.state_path)
         else:
@@ -486,6 +507,16 @@ class GeneticOptimizer(Logger):
             fits = self._fitness_many(pop, gen=0)
             self._save_state(0, pop, fits)
         for gen in range(start_gen, self.generations):
+            if self._stop_check is not None and self._stop_check():
+                # graceful stop at the generation boundary: the
+                # checkpoint written after the previous generation is
+                # the resume point; a resumed run continues the
+                # remaining generations bit-identically
+                telemetry.event("preempt.ga_stop", generation=gen)
+                self.warning(
+                    "graceful stop: breeding halted before generation "
+                    "%d; resume continues from the checkpoint", gen)
+                break
             order = np.argsort(fits)
             pop, fits = pop[order], fits[order]
             self.history.append([(float(f), self._decode(g))

@@ -453,6 +453,13 @@ def main(argv=None) -> int:
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
 
+    # genome workers (serve-mode evaluator AND one-shot subprocesses)
+    # are GA children: preemption semantics belong to the parent, so
+    # their Launchers must not install graceful-stop handlers — a
+    # signaled worker dies plainly and the parent's retry/inf contract
+    # handles it, exactly as before Phoenix
+    os.environ["VELES_PREEMPT_DISABLE"] = "1"
+
     if args.serve:
         return serve(args)
     if args.values is None:

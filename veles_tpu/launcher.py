@@ -16,10 +16,13 @@ from __future__ import annotations
 
 import importlib.util
 import os
+import re
 import sys
+import threading
+import time
 from typing import Any, Optional
 
-from veles_tpu import prng, telemetry
+from veles_tpu import faults, prng, telemetry
 from veles_tpu.backends import Device, make_device
 from veles_tpu.config import root
 from veles_tpu.logger import Logger, setup_logging
@@ -49,6 +52,13 @@ class Launcher(Logger):
         self.status_server = status_server
         self.profile_dir = profile
         self.multihost = multihost
+        #: Phoenix graceful-stop state: the signal that requested
+        #: preemption (None = not preempted), an event the main thread
+        #: sets once the final snapshot landed (stops the grace
+        #: watchdog), and the multihost watchdog stopper
+        self._preempt_signum: Optional[int] = None
+        self._preempt_done = threading.Event()
+        self._mh_watchdog_stop = None
         prng.seed_all(seed)
         if multihost:
             init_multihost()
@@ -116,6 +126,11 @@ class Launcher(Logger):
         from veles_tpu import profiling
         watchdog_stop = self._start_multihost_watchdog() \
             if self.multihost else None
+        self._mh_watchdog_stop = watchdog_stop
+        uninstall = self._install_preempt_handlers()
+        faults.maybe_inject_sigterm(
+            attempt=os.environ.get("VELES_SUPERVISE_ATTEMPT", "0"),
+            mode=self.mode)
         try:
             with profiling.trace(self.profile_dir):
                 if self.mode == "standalone":
@@ -134,6 +149,10 @@ class Launcher(Logger):
                     SlaveClient(self.workflow,
                                 self.master_address).serve()
         except (KeyboardInterrupt, SystemExit):
+            # with the preempt handlers installed a Ctrl-C never gets
+            # here (SIGINT routes through the graceful-stop path and
+            # leaves a final snapshot); this survives for embedding
+            # contexts where the handlers could not be installed
             raise
         except BaseException as e:
             if self.multihost:
@@ -143,48 +162,232 @@ class Launcher(Logger):
                 self._abort_multihost(e)
             raise
         finally:
+            uninstall()
             if watchdog_stop is not None:
                 watchdog_stop()
+        if self._preempt_signum is not None:
+            self._finish_preempt()   # never returns: os._exit(14)
         if self.profile_dir:
             self._dump_flops_table()
 
     #: exit code of a clean multihost peer-failure abort (documented
     #: in docs/guide.md "Operating long runs")
     MULTIHOST_ABORT_EXIT = 13
+    #: exit code of a preemption-triggered graceful stop (SIGTERM /
+    #: SIGINT / a peer's ``veles_preempt`` broadcast): the run stopped
+    #: at a dispatch boundary and wrote a final resumable snapshot —
+    #: the supervisor always resumes 13/14 without charging the crash
+    #: budget
+    PREEMPT_EXIT = 14
+    #: seconds the graceful stop may take before the watchdog thread
+    #: hard-snapshots and exits (the main thread may be wedged inside
+    #: a long dispatch or a dead collective)
+    PREEMPT_GRACE_ENV = "VELES_PREEMPT_GRACE"
+    PREEMPT_GRACE_DEFAULT = 25.0
 
-    def _emergency_snapshot(self) -> Optional[str]:
-        """Best-effort final snapshot for an abort path; None when it
-        could not be written (the abort must land regardless)."""
+    # -- graceful stop (Phoenix) --------------------------------------
+
+    def _install_preempt_handlers(self):
+        """SIGTERM/SIGINT -> cooperative stop at the next dispatch
+        boundary + final snapshot + exit 14.  Installable only from
+        the main thread (tests and embedders calling ``run()`` from a
+        worker thread keep the old raise-through behavior); returns an
+        uninstall callable either way.  ``$VELES_PREEMPT_DISABLE=1``
+        opts a process out entirely — the serve-mode GA evaluator sets
+        it so a group-wide Ctrl-C can't make every genome child dump a
+        'final snapshot' of its scratch workflow into the lineage
+        (preemption semantics belong to the GA parent; a dying
+        evaluator is the pool's retry-once path, as before)."""
+        import signal
+        if os.environ.get("VELES_PREEMPT_DISABLE") == "1":
+            return lambda: None
+        if threading.current_thread() is not threading.main_thread():
+            return lambda: None
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev[sig] = signal.signal(sig, self._on_preempt_signal)
+            except (ValueError, OSError):  # non-main interp / platform
+                pass
+
+        def uninstall() -> None:
+            for sig, handler in prev.items():
+                try:
+                    signal.signal(sig, handler)
+                except (ValueError, OSError):
+                    pass
+        return uninstall
+
+    def _on_preempt_signal(self, signum, frame) -> None:
+        # handler body is minimal and allocation-light: the interrupted
+        # main thread may hold arbitrary locks (telemetry journal,
+        # logging), so flag + request + hand off to a watchdog THREAD
+        # which does the talking
+        if self._preempt_signum is not None:
+            # second signal: the operator (or the platform) insists —
+            # exit right now; the watchdog/final snapshot may be
+            # mid-write, the newest intact candidate still resumes
+            os.write(2, b"veles: second preempt signal - hard exit\n")
+            os._exit(self.PREEMPT_EXIT)
+        self._preempt_signum = signum
+        self._begin_graceful_stop(publish=True)
+
+    def _begin_graceful_stop(self, publish: bool) -> None:
+        """Request a cooperative stop and arm the grace watchdog.
+        Called from the signal handler or (multihost) from the
+        ``veles_preempt`` watcher thread."""
+        wf = self.workflow
+        if wf is not None and hasattr(wf, "request_stop"):
+            wf.request_stop()
+        threading.Thread(target=self._preempt_watchdog,
+                         args=(publish,), daemon=True,
+                         name="preempt-watchdog").start()
+
+    def preempt_grace(self) -> float:
+        return float(os.environ.get(self.PREEMPT_GRACE_ENV,
+                                    str(self.PREEMPT_GRACE_DEFAULT)))
+
+    def _preempt_signal_name(self) -> str:
+        import signal
+        try:
+            return signal.Signals(self._preempt_signum).name
+        except (ValueError, TypeError):
+            return f"sig{self._preempt_signum}"
+
+    def _preempt_watchdog(self, publish: bool) -> None:
+        grace = self.preempt_grace()
+        name = self._preempt_signal_name()
+        telemetry.event("preempt.requested", signal=name, grace=grace,
+                        multihost=self.multihost)
+        self.warning(
+            "preemption requested (%s): stopping at the next dispatch "
+            "boundary; final snapshot due within %.0fs "
+            "($%s)", name, grace, self.PREEMPT_GRACE_ENV)
+        if publish and self.multihost:
+            # coordinated preemption: ALL peers must snapshot and exit
+            # 14 together (a lone exit would read as peer death and
+            # trigger the abort path on the survivors)
+            client = self._kv_client()
+            if client is not None:
+                try:
+                    client.key_value_set("veles_preempt", name)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+        if self._preempt_done.wait(grace):
+            return   # the main thread finished the graceful stop
+        # wedged (long dispatch, dead collective, serve loop): write
+        # the final snapshot from THIS thread, bounded, then exit —
+        # preemption must never outlive the platform's kill deadline
+        self.error("graceful stop missed the %.0fs grace deadline — "
+                   "hard final snapshot from the watchdog", grace)
+        telemetry.event("preempt.deadline_exceeded", grace=grace)
+        result: dict = {}
+
+        def snap() -> None:
+            result["path"] = self.final_snapshot(f"preempt-{name}")
+
+        t = threading.Thread(target=snap, daemon=True,
+                             name="preempt-final-snapshot")
+        t.start()
+        t.join(timeout=max(10.0, grace))
+        telemetry.flush()
+        import logging
+        logging.shutdown()
+        sys.stderr.flush()
+        os._exit(self.PREEMPT_EXIT)
+
+    def _finish_preempt(self) -> None:
+        """Main-thread completion of a graceful stop: the run loop
+        stopped at a dispatch boundary, so write the final snapshot,
+        journal, flush, and exit 14 (``os._exit`` — under multihost a
+        normal interpreter exit would hang in jax's distributed
+        shutdown barrier against peers that already left)."""
+        name = self._preempt_signal_name()
+        t0 = time.perf_counter()
+        path = self.final_snapshot(f"preempt-{name}")
+        dt = time.perf_counter() - t0
+        telemetry.gauge("preempt.snapshot_seconds").set(round(dt, 3))
+        self._preempt_done.set()
+        self.warning(
+            "preempted (%s): final snapshot %s (%.2fs); exiting %d",
+            name, path or "FAILED", dt, self.PREEMPT_EXIT)
+        telemetry.flush()
+        import logging
+        logging.shutdown()
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(self.PREEMPT_EXIT)
+
+    def _kv_client(self):
+        """The jax distributed KV client, or None outside a real
+        multi-process run."""
+        try:
+            from jax._src.distributed import global_state
+            return global_state.client
+        except Exception:  # noqa: BLE001 — no distributed context
+            return None
+
+    def final_snapshot(self, reason: str) -> Optional[str]:
+        """Best-effort final snapshot for a stop/abort path; None when
+        it could not be written (the exit must land regardless).
+
+        Generalizes PR 6's ``_emergency_snapshot``: the file is named
+        INTO the Snapshotter lineage
+        (``<prefix>_final_<reason>_pid<pid>.pickle.gz`` in the
+        snapshotter's directory), so ``snapshot_candidates()`` resume
+        discovery finds it, and the resume manifest is pointed at it —
+        ``--supervise`` restarts need no flags."""
         try:
             if self.workflow is None:
                 return None
-            from veles_tpu.snapshotter import save_workflow
+            from veles_tpu.snapshotter import (save_workflow,
+                                               write_resume_manifest)
             snap = getattr(self.workflow, "snapshotter", None)
             directory = snap.directory if snap is not None else \
                 os.path.join(os.path.expanduser("~"),
                              ".veles_tpu", "snapshots")
+            prefix = getattr(snap, "prefix", None) or "snapshot"
             os.makedirs(directory, exist_ok=True)
+            safe = re.sub(r"[^A-Za-z0-9._-]+", "-", reason)
             path = os.path.join(
                 directory,
-                f"multihost_abort_pid{os.getpid()}.pickle.gz")
+                f"{prefix}_final_{safe}_pid{os.getpid()}.pickle.gz")
+            t0 = time.perf_counter()
             out = save_workflow(self.workflow, path)
-            telemetry.counter("multihost.emergency_snapshots").inc()
-            telemetry.event("multihost.emergency_snapshot", path=out)
+            dt = round(time.perf_counter() - t0, 3)
+            if reason.startswith("multihost"):
+                telemetry.counter("multihost.emergency_snapshots").inc()
+                telemetry.event("multihost.emergency_snapshot",
+                                path=out, seconds=dt)
+            else:
+                telemetry.counter("preempt.final_snapshots").inc()
+                telemetry.event("preempt.final_snapshot", path=out,
+                                reason=reason, seconds=dt)
+            write_resume_manifest(snapshot=out, reason=reason)
             telemetry.flush()   # os._exit follows — atexit never runs
             return out
-        except Exception as e:  # noqa: BLE001 — the abort must land
-            self.warning("emergency snapshot failed: %s", e)
+        except Exception as e:  # noqa: BLE001 — the exit must land
+            self.warning("final snapshot (%s) failed: %s", reason, e)
             return None
+
+    def _emergency_snapshot(self) -> Optional[str]:
+        """PR-6 name kept for callers/tests; now writes into the
+        snapshot lineage via ``final_snapshot``."""
+        return self.final_snapshot("multihost-abort")
 
     def _abort_multihost(self, exc: BaseException) -> None:
         """A collective failed under --multihost (peer death, network
         partition): write a final emergency snapshot of the local
         workflow state and exit with a distinctive code — the
-        operator's restart-from-snapshot path, not a hang and not a
+        supervisor's restart-from-snapshot path, not a hang and not a
         lost run."""
         telemetry.event("multihost.collective_failed",
                         error=f"{type(exc).__name__}: {exc}")
         path = self._emergency_snapshot()
+        # flush UNCONDITIONALLY: when the snapshot failed, the flush
+        # inside final_snapshot never ran and the journal events above
+        # (collective_failed, peer_death) would die with os._exit
+        telemetry.flush()
         self.error(
             "multihost collective failed (%s: %s) — peer death or "
             "partition; aborting cleanly%s",
@@ -279,10 +482,39 @@ class Launcher(Logger):
                     pass
                 if stop.is_set():
                     return
+                if self._preempt_signum is not None:
+                    # coordinated preemption in flight: a silent peer
+                    # is exiting 14 like us, not dying — never convert
+                    # a preemption into a peer-death abort
+                    return
                 self._peer_death_abort(peer, deadline)
+
+        def watch_preempt() -> None:
+            # a peer that catches SIGTERM broadcasts ``veles_preempt``
+            # so the WHOLE slice snapshots and exits 14 together —
+            # coordinated resume, not a peer-death abort
+            import signal as _signal
+            while not stop.is_set():
+                try:
+                    client.blocking_key_value_get("veles_preempt",
+                                                  5000)
+                except Exception:  # noqa: BLE001 — timeout: re-poll
+                    continue
+                if stop.is_set():
+                    return
+                if self._preempt_signum is None:
+                    self._preempt_signum = int(_signal.SIGTERM)
+                    telemetry.event("preempt.peer_broadcast")
+                    self.warning("peer broadcast veles_preempt — "
+                                 "joining the coordinated graceful "
+                                 "stop")
+                    self._begin_graceful_stop(publish=False)
+                return
 
         threading.Thread(target=beat, daemon=True,
                          name="mh-heartbeat").start()
+        threading.Thread(target=watch_preempt, daemon=True,
+                         name="mh-watch-preempt").start()
         for p in peers:
             threading.Thread(target=watch, args=(p,), daemon=True,
                              name=f"mh-watch-{p}").start()
@@ -305,7 +537,6 @@ class Launcher(Logger):
         from here with a bounded grace period, then the process exits
         with the clean abort code (never hangs, never waits for the
         coordination service's SIGABRT)."""
-        import threading
         telemetry.event("multihost.peer_death", peer=peer,
                         deadline=deadline)
         self.error(
@@ -321,6 +552,10 @@ class Launcher(Logger):
                              name="mh-final-snapshot")
         t.start()
         t.join(timeout=30.0)
+        # flush UNCONDITIONALLY: a failed/hung snapshot skipped the
+        # flush inside final_snapshot, and the peer_death event above
+        # must survive os._exit
+        telemetry.flush()
         path = result.get("path")
         self.error("multihost peer failure: aborting cleanly%s",
                    f"; final snapshot: {path}" if path
@@ -406,12 +641,24 @@ def init_multihost() -> None:
                 process_id=int(pid) if pid else None)
         except RuntimeError as e:
             # Backend already up (e.g. the embedding process made a JAX
-            # call first) — single-process semantics are the only safe
-            # fallback; surface it loudly rather than crash.
-            import logging
-            logging.getLogger("veles_tpu.launcher").warning(
-                "jax.distributed.initialize() refused (%s); continuing "
-                "single-process", e)
+            # call first).  A --multihost launch that silently runs
+            # single-process would train on 1/N of the data and
+            # checkpoint a state no peer can join — fail LOUDLY unless
+            # the operator explicitly accepts solo semantics.
+            telemetry.event("multihost.init_refused", error=str(e))
+            if os.environ.get("VELES_MULTIHOST_ALLOW_SOLO") == "1":
+                import logging
+                logging.getLogger("veles_tpu.launcher").warning(
+                    "jax.distributed.initialize() refused (%s); "
+                    "continuing single-process "
+                    "($VELES_MULTIHOST_ALLOW_SOLO=1)", e)
+            else:
+                raise RuntimeError(
+                    "--multihost launch refused by "
+                    f"jax.distributed.initialize() ({e}); refusing to "
+                    "continue single-process — set "
+                    "VELES_MULTIHOST_ALLOW_SOLO=1 to accept solo "
+                    "semantics") from e
     _multihost_initialized = True
     _maybe_inject_peer_exit()
 

@@ -21,12 +21,22 @@ envelope; a torn or corrupt file raises ``SnapshotCorruptError``, and
 newest-first to resume from the newest INTACT predecessor instead of
 crashing — and raises (never silently starts fresh) when none is
 intact.  Pre-envelope snapshots still load (no CRC to check).
+
+Resume manifest (Phoenix): every snapshot/checkpoint writer also
+updates a small ``resume_manifest.json`` next to the snapshot (and at
+``$VELES_RESUME_MANIFEST`` when the supervisor exported one) recording
+the newest snapshot path, the GA state path, and the metrics dir —
+so ``python -m veles_tpu --supervise`` can restart a died run from its
+newest intact state with no operator flags.  ``verify_snapshot``
+checks the CRC envelope WITHOUT unpickling (the supervisor must probe
+candidates without importing the model classes they pickle).
 """
 
 from __future__ import annotations
 
 import bz2
 import gzip
+import json
 import lzma
 import os
 import pickle
@@ -145,17 +155,22 @@ def snapshot_candidates(path: str) -> List[str]:
     directory = os.path.dirname(os.path.abspath(path)) or "."
     base = os.path.basename(path)
     # the family prefix: everything before the rolling part.  The
-    # Snapshotter names files <prefix>_epoch<N>...; manual saves share
-    # at least the leading alpha run of the basename.
-    stem = base.split("_epoch")[0] if "_epoch" in base \
-        else os.path.splitext(base)[0]
+    # Snapshotter names files <prefix>_epoch<N>..., final/preemption
+    # snapshots <prefix>_final_<reason>... (same lineage, so resume
+    # discovers them); manual saves share at least the leading alpha
+    # run of the basename.
+    stem = base.split("_epoch")[0]
+    stem = stem.split("_final")[0]
+    if stem == base:
+        stem = os.path.splitext(base)[0]
     try:
         entries = os.listdir(directory)
     except OSError:
         return []
     cands = []
     for name in entries:
-        if name == base or name.endswith(".tmp"):
+        if name == base or name.endswith(
+                (".tmp", ".json", ".prev", ".merged", ".jsonl")):
             continue
         if not name.startswith(stem):
             continue
@@ -164,6 +179,104 @@ def snapshot_candidates(path: str) -> List[str]:
             cands.append(full)
     cands.sort(key=lambda p: os.path.getmtime(p), reverse=True)
     return cands
+
+
+def verify_snapshot(path: str) -> bool:
+    """True when ``path`` reads as an intact snapshot — CRC-envelope
+    verification WITHOUT unpickling, so the supervisor can probe
+    resume candidates cheaply and without importing whatever classes
+    the snapshot pickles.  Pre-envelope (format-1) files are checked
+    for decompressability only (they carry no CRC)."""
+    try:
+        with _opener(path)(path, "rb") as f:
+            head = f.read(len(MAGIC))
+            if head == MAGIC:
+                meta = f.read(_HEADER.size)
+                if len(meta) != _HEADER.size:
+                    return False
+                length, crc = _HEADER.unpack(meta)
+                blob = f.read(length)
+                return len(blob) == length and \
+                    (zlib.crc32(blob) & 0xFFFFFFFF) == crc
+            # format 1: no CRC — a full decompressed read is the best
+            # available tear check
+            while f.read(1 << 20):
+                pass
+        return True
+    except Exception:  # noqa: BLE001 — any read error = not intact
+        return False
+
+
+#: supervisor-exported override for where the resume manifest lives
+#: (in addition to the copy next to the snapshot)
+MANIFEST_ENV = "VELES_RESUME_MANIFEST"
+MANIFEST_NAME = "resume_manifest.json"
+
+
+def _write_json_atomic(path: str, payload: dict) -> None:
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(path) + ".",
+        suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_resume_manifest(path: str) -> Optional[dict]:
+    """The manifest dict, or None when missing/unparseable (the
+    supervisor then falls back to the child's own flags)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def write_resume_manifest(snapshot: Optional[str] = None,
+                          ga_state: Optional[str] = None,
+                          reason: Optional[str] = None) -> List[str]:
+    """Merge-update the resume manifest(s): next to the snapshot (the
+    operator's flag-less resume pointer) and at
+    ``$VELES_RESUME_MANIFEST`` when the supervisor exported one.
+    Non-None fields overwrite; the rest persist, so a GA checkpoint
+    update never clobbers the snapshot pointer and vice versa.
+    Best-effort: manifest failures must never take down the run."""
+    targets = []
+    env_path = os.environ.get(MANIFEST_ENV)
+    if env_path:
+        targets.append(env_path)
+    if snapshot:
+        nxt = os.path.join(
+            os.path.dirname(os.path.abspath(snapshot)), MANIFEST_NAME)
+        if nxt not in targets:
+            targets.append(nxt)
+    written = []
+    for path in targets:
+        try:
+            payload = read_resume_manifest(path) or {"format": 1}
+            if snapshot:
+                payload["snapshot"] = os.path.abspath(snapshot)
+            if ga_state:
+                payload["ga_state"] = os.path.abspath(ga_state)
+            if reason:
+                payload["reason"] = reason
+            payload["metrics_dir"] = telemetry.metrics_dir()
+            payload["pid"] = os.getpid()
+            payload["ts"] = round(time.time(), 3)
+            _write_json_atomic(path, payload)
+            written.append(path)
+        except OSError:
+            continue
+    return written
 
 
 def load_workflow(path: str, fallback: bool = False):
@@ -245,6 +358,9 @@ class Snapshotter(Unit):
             f"{self.prefix}_epoch{epoch}{err}.pickle{suffix}")
         save_workflow(self.workflow, path)
         self.last_path = path
+        # keep the flag-less resume pointer current: a SIGKILL between
+        # epochs still leaves the supervisor the newest snapshot path
+        write_resume_manifest(snapshot=path)
         self.info("snapshot -> %s", path)
         self._written.append(path)
         while len(self._written) > self.keep:

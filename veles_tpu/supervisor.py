@@ -1,0 +1,442 @@
+"""Phoenix: the run supervisor — auto-resume long runs from the
+newest intact state.
+
+Faultline (PR 6) made every failure die CLEANLY (exit 13 + emergency
+snapshot, CRC-verified checkpoints, fallback-to-intact loaders) and
+Sightline (PR 7) made it observable — but the loop still ended at a
+human restarting the run.  Phoenix closes it: preemption becomes a
+*graceful stop* (SIGTERM/SIGINT -> cooperative stop at the next
+dispatch boundary -> final snapshot inside ``$VELES_PREEMPT_GRACE``
+-> exit 14), and this module's supervisor turns any child death into
+an automatic, flag-less resume.
+
+The exit-code contract (pinned in tests/test_supervisor.py)::
+
+    0    done               -> the supervisor exits 0
+    13   multihost abort    -> ALWAYS resume; never charged to the
+                               crash budget (a peer died cleanly)
+    14   preempted          -> ALWAYS resume; never charged (the
+                               platform reclaimed the machine)
+    2    usage error        -> give up immediately (deterministic)
+    else crash              -> resume from the newest intact state,
+                               charged to the crash budget
+
+Resume needs no operator flags: every snapshot/checkpoint writer in
+the child updates a *resume manifest* (snapshotter.py
+``write_resume_manifest`` — snapshot path, GA state path, metrics
+dir), the supervisor exports ``$VELES_RESUME_MANIFEST`` so the child
+knows where, and on each restart the ``--snapshot`` argument is
+rewritten to the newest INTACT candidate (CRC-probed without
+unpickling; siblings walked newest-first when the manifest's pointer
+is torn).  GA runs resume through their own ``--ga-state`` file —
+the checkpoint is re-read by the child, bit-identically.
+
+Crash-loop protection mirrors the evaluator pool's restart shape
+(genetics/pool.py): exponential backoff with deterministic +-25%
+jitter between consecutive crashes, and ``max_crashes`` failures
+inside ``crash_window`` seconds give up LOUDLY (``supervisor.giveup``
+journal event, child's exit code propagated).  Every transition is
+journaled (``supervisor.restart`` / ``supervisor.resumed`` /
+``supervisor.giveup``, ``supervisor.restarts`` counter,
+``supervisor.downtime_seconds`` histogram).
+
+Entry points::
+
+    python -m veles_tpu --supervise [any normal CLI args...]
+    supervisor.run(argv)                       # same, programmatic
+    Supervisor(argv, command=[...]).run()      # custom child command
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from veles_tpu import telemetry
+from veles_tpu.logger import Logger
+
+#: the exit-code contract (kept equal to Launcher's constants; the
+#: cross-check is pinned in tests/test_supervisor.py without importing
+#: launcher here — the supervisor must stay importable without jax)
+EXIT_DONE = 0
+EXIT_MULTIHOST_ABORT = 13
+EXIT_PREEMPTED = 14
+EXIT_USAGE = 2
+#: codes that always resume and never charge the crash budget
+RESUME_CODES = frozenset((EXIT_MULTIHOST_ABORT, EXIT_PREEMPTED))
+
+MAX_CRASHES_ENV = "VELES_SUPERVISE_MAX_CRASHES"
+CRASH_WINDOW_ENV = "VELES_SUPERVISE_CRASH_WINDOW"
+#: exported to each child: 0 for the first attempt, incrementing per
+#: restart — Faultline qualifiers (``@attempt=0``) target one attempt
+ATTEMPT_ENV = "VELES_SUPERVISE_ATTEMPT"
+
+
+def _normalize_rc(rc: int) -> int:
+    """subprocess returncode -> shell-convention exit code (signal
+    deaths surface as negative returncodes; 128+sig keeps them
+    nonzero and distinguishable when we propagate them)."""
+    return 128 - rc if rc < 0 else rc
+
+
+class Supervisor(Logger):
+    """Spawn-and-resume loop around one run command.
+
+    ``argv`` is the child's CLI tail; ``command`` defaults to
+    ``[sys.executable, "-m", "veles_tpu"]``.  Knobs (env defaults in
+    parentheses): ``max_crashes`` ($VELES_SUPERVISE_MAX_CRASHES, 5)
+    genuine crashes inside ``crash_window``
+    ($VELES_SUPERVISE_CRASH_WINDOW, 300 s) give up;
+    ``restart_backoff``/``restart_backoff_cap`` (0.5 s / 30 s) shape
+    the between-crash delay exactly like the evaluator pool's.
+    """
+
+    name = "supervisor"
+
+    def __init__(self, argv: List[str],
+                 command: Optional[List[str]] = None,
+                 max_crashes: Optional[int] = None,
+                 crash_window: Optional[float] = None,
+                 restart_backoff: float = 0.5,
+                 restart_backoff_cap: float = 30.0,
+                 seed: int = 1234,
+                 manifest_path: Optional[str] = None) -> None:
+        self.argv = list(argv)
+        self.command = list(command) if command else \
+            [sys.executable, "-m", "veles_tpu"]
+        self.max_crashes = int(
+            os.environ.get(MAX_CRASHES_ENV, "5")
+            if max_crashes is None else max_crashes)
+        self.crash_window = float(
+            os.environ.get(CRASH_WINDOW_ENV, "300")
+            if crash_window is None else crash_window)
+        self.restart_backoff = restart_backoff
+        self.restart_backoff_cap = restart_backoff_cap
+        self._backoff_rng = np.random.default_rng(seed ^ 0x5EED)
+        # the supervisor's own journal (restart/resumed/giveup) must
+        # land in the run's metrics dir even when it was given as a
+        # flag rather than the env var (configure() exports the var,
+        # so the child inherits it exactly as if it had the flag)
+        mdir = self._argv_value("--metrics-dir")
+        if mdir and not telemetry.metrics_dir():
+            telemetry.configure(mdir)
+        self.manifest_path = manifest_path or self._default_manifest()
+        self._child: Optional[subprocess.Popen] = None
+        self._shutdown_sig: Optional[int] = None
+        #: restarts performed so far (mirrors supervisor.restarts)
+        self.restarts = 0
+
+    def _default_manifest(self) -> str:
+        """$VELES_RESUME_MANIFEST when the caller exported one, else a
+        file inside the run's metrics dir (one artifact dir per run),
+        else a supervisor-owned temp dir."""
+        from veles_tpu.snapshotter import MANIFEST_ENV, MANIFEST_NAME
+        env = os.environ.get(MANIFEST_ENV)
+        if env:
+            return env
+        mdir = self._argv_value("--metrics-dir") or \
+            telemetry.metrics_dir()
+        if mdir:
+            return os.path.join(mdir, MANIFEST_NAME)
+        import tempfile
+        return os.path.join(
+            tempfile.mkdtemp(prefix="veles_supervise_"), MANIFEST_NAME)
+
+    def _argv_value(self, flag: str) -> Optional[str]:
+        for i, a in enumerate(self.argv):
+            if a == flag and i + 1 < len(self.argv):
+                return self.argv[i + 1]
+            if a.startswith(flag + "="):
+                return a.split("=", 1)[1]
+        return None
+
+    # -- resume-state discovery ---------------------------------------
+
+    def newest_intact_snapshot(self) -> Optional[str]:
+        """The newest CRC-intact snapshot the run left behind: the
+        manifest's pointer first, then its lineage siblings
+        newest-first.  None when the run never snapshotted (or the
+        manifest is gone) — the child then starts from its own flags."""
+        from veles_tpu.snapshotter import (read_resume_manifest,
+                                           snapshot_candidates,
+                                           verify_snapshot)
+        manifest = read_resume_manifest(self.manifest_path) or {}
+        pointer = manifest.get("snapshot") or \
+            self._argv_value("--snapshot")
+        if not pointer:
+            return None
+        for cand in [pointer] + snapshot_candidates(pointer):
+            if os.path.isfile(cand) and verify_snapshot(cand):
+                return cand
+            self.warning("resume candidate %s is torn/missing; "
+                         "walking the lineage", cand)
+        return None
+
+    def _argv_for_attempt(self, attempt: int,
+                          downtime: Optional[float]) -> List[str]:
+        """The child argv, with ``--snapshot`` rewritten to the newest
+        intact candidate on restarts.  GA runs (--optimize) resume
+        through their own --ga-state file and are left untouched."""
+        argv = list(self.argv)
+        if attempt == 0:
+            return argv
+        from veles_tpu.snapshotter import read_resume_manifest
+        manifest = read_resume_manifest(self.manifest_path) or {}
+        source, state = "fresh", None
+        if "--optimize" not in argv:
+            snap = self.newest_intact_snapshot()
+            if snap:
+                source, state = "snapshot", snap
+                done = False
+                for i, a in enumerate(argv):
+                    if a == "--snapshot" and i + 1 < len(argv):
+                        argv[i + 1] = snap
+                        done = True
+                    elif a.startswith("--snapshot="):
+                        argv[i] = f"--snapshot={snap}"
+                        done = True
+                if not done:
+                    # flags must precede the positional workflow file?
+                    # argparse interleaves fine — append is safe
+                    argv += ["--snapshot", snap]
+        elif manifest.get("ga_state"):
+            source, state = "ga_state", manifest["ga_state"]
+        telemetry.event("supervisor.resumed", attempt=attempt,
+                        source=source, state=state,
+                        downtime=None if downtime is None
+                        else round(downtime, 3))
+        self.info("attempt %d resumes from %s%s", attempt, source,
+                  f" ({state})" if state else "")
+        return argv
+
+    # -- the loop ------------------------------------------------------
+
+    def _install_forwarding(self):
+        """Supervisor-side SIGTERM/SIGINT: forward to the child and do
+        NOT resume its resulting exit — the platform is reclaiming us
+        too.  Main-thread only; returns an uninstall callable."""
+        import signal
+        if threading.current_thread() is not threading.main_thread():
+            return lambda: None
+
+        def handler(signum, frame):
+            self._shutdown_sig = signum
+            child = self._child
+            if child is not None and child.poll() is None:
+                try:
+                    child.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev[sig] = signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
+
+        def uninstall():
+            for sig, h in prev.items():
+                try:
+                    signal.signal(sig, h)
+                except (ValueError, OSError):
+                    pass
+        return uninstall
+
+    def run(self) -> int:
+        from veles_tpu.snapshotter import MANIFEST_ENV
+        uninstall = self._install_forwarding()
+        attempt = 0
+        crash_times: deque = deque()
+        consecutive_crashes = 0
+        last_death: Optional[float] = None
+        self.info("supervising: %s (manifest: %s, crash budget %d/"
+                  "%.0fs)", " ".join(self.command + self.argv),
+                  self.manifest_path, self.max_crashes,
+                  self.crash_window)
+        try:
+            while True:
+                now = time.monotonic()
+                downtime = None if last_death is None \
+                    else now - last_death
+                if downtime is not None:
+                    telemetry.histogram(
+                        "supervisor.downtime_seconds").record(downtime)
+                argv = self._argv_for_attempt(attempt, downtime)
+                env = dict(os.environ)
+                env[MANIFEST_ENV] = self.manifest_path
+                env[ATTEMPT_ENV] = str(attempt)
+                self._child = subprocess.Popen(
+                    self.command + argv, env=env)
+                rc = self._child.wait()
+                last_death = time.monotonic()
+                code = _normalize_rc(rc)
+                if self._shutdown_sig is not None:
+                    self.warning("supervisor was signaled — not "
+                                 "resuming; child exited %d", code)
+                    telemetry.event("supervisor.shutdown", rc=code)
+                    return code
+                if code == EXIT_DONE:
+                    telemetry.event("supervisor.done",
+                                    attempts=attempt + 1)
+                    self.info("run complete after %d attempt(s), "
+                              "%d restart(s)", attempt + 1,
+                              self.restarts)
+                    return EXIT_DONE
+                if code in RESUME_CODES:
+                    kind = "preempt" if code == EXIT_PREEMPTED \
+                        else "multihost_abort"
+                    consecutive_crashes = 0
+                    self._note_restart(code, attempt, kind, 0.0)
+                    attempt += 1
+                    continue   # immediate respawn, budget untouched
+                if code == EXIT_USAGE:
+                    # argparse/config errors are deterministic: a
+                    # restart loop would fail identically forever
+                    telemetry.event("supervisor.giveup", rc=code,
+                                    reason="usage_error")
+                    self.error("child failed with a usage error (2); "
+                               "giving up")
+                    return code
+                # a genuine crash: charge the budget
+                crash_times.append(last_death)
+                while crash_times and \
+                        last_death - crash_times[0] > self.crash_window:
+                    crash_times.popleft()
+                if len(crash_times) >= self.max_crashes:
+                    telemetry.event(
+                        "supervisor.giveup", rc=code,
+                        crashes=len(crash_times),
+                        window=self.crash_window)
+                    telemetry.flush()
+                    self.error(
+                        "crash loop: %d failures inside %.0fs "
+                        "(budget %d) — giving up; last exit %d",
+                        len(crash_times), self.crash_window,
+                        self.max_crashes, code)
+                    return code
+                consecutive_crashes += 1
+                delay = self._backoff(consecutive_crashes)
+                self._note_restart(code, attempt, "crash", delay)
+                if delay > 0:
+                    time.sleep(delay)
+                attempt += 1
+        finally:
+            uninstall()
+            child = self._child
+            if child is not None and child.poll() is None:
+                child.kill()
+
+    def _backoff(self, consecutive: int) -> float:
+        """The evaluator pool's restart shape: first restart
+        immediate, then exponential with deterministic +-25% jitter."""
+        if consecutive <= 1:
+            return 0.0
+        delay = min(self.restart_backoff_cap,
+                    self.restart_backoff * (2.0 ** (consecutive - 2)))
+        return delay * (0.75 + 0.5 * float(self._backoff_rng.random()))
+
+    def _note_restart(self, code: int, attempt: int, kind: str,
+                      delay: float) -> None:
+        self.restarts += 1
+        telemetry.counter("supervisor.restarts").inc()
+        telemetry.event("supervisor.restart", rc=code, attempt=attempt,
+                        kind=kind, budget_charged=(kind == "crash"),
+                        delay=round(delay, 3))
+        self.warning("child exited %d (%s) — restarting (attempt %d"
+                     "%s)", code, kind, attempt + 1,
+                     f", backoff {delay:.2f}s" if delay else "")
+
+
+def run(argv: List[str], **kwargs) -> int:
+    """``python -m veles_tpu --supervise ...`` lands here: supervise
+    the same CLI invocation minus the flag."""
+    return Supervisor(argv, **kwargs).run()
+
+
+# -- child-side GA graceful stop --------------------------------------
+
+def install_ga_stop(grace: Optional[float] = None,
+                    ) -> Tuple[callable, callable]:
+    """Graceful stop for the GA parent (``--optimize`` runs have no
+    Launcher): SIGTERM/SIGINT requests a cooperative stop at the next
+    GENERATION boundary — the per-generation ``--ga-state`` checkpoint
+    already on disk is the resume point — and a watchdog enforces
+    ``$VELES_PREEMPT_GRACE`` (a generation can outlive any grace
+    deadline; the checkpoint loses only the in-flight generation,
+    which the resumed run re-evaluates bit-identically).
+
+    Returns ``(stop_check, finish)``: pass ``stop_check`` to
+    ``GeneticOptimizer(stop_check=...)``; call ``finish()`` after the
+    run — it returns the exit code to use (14) when a stop was
+    requested, else None.  No-op (never stops) off the main thread.
+    """
+    import logging
+    import signal
+    log = logging.getLogger("veles.supervisor")
+    state = {"sig": None}
+    done = threading.Event()
+    if threading.current_thread() is not threading.main_thread():
+        return (lambda: False), (lambda: None)
+    if grace is None:
+        grace = float(os.environ.get("VELES_PREEMPT_GRACE", "25"))
+
+    def watchdog(name: str) -> None:
+        telemetry.event("preempt.requested", signal=name, grace=grace,
+                        mode="ga")
+        log.warning(
+            "preemption requested (%s): stopping at the next GA "
+            "generation boundary (checkpoint = resume point); hard "
+            "exit in %.0fs", name, grace)
+        if done.wait(grace):
+            return
+        telemetry.event("preempt.deadline_exceeded", grace=grace,
+                        mode="ga")
+        log.error("GA graceful stop missed the %.0fs grace deadline "
+                  "— exiting %d on the last checkpoint", grace,
+                  EXIT_PREEMPTED)
+        telemetry.flush()
+        logging.shutdown()
+        os._exit(EXIT_PREEMPTED)
+
+    def handler(signum, frame):
+        if state["sig"] is not None:
+            os._exit(EXIT_PREEMPTED)
+        state["sig"] = signum
+        threading.Thread(
+            target=watchdog, args=(signal.Signals(signum).name,),
+            daemon=True, name="ga-preempt-watchdog").start()
+
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[sig] = signal.signal(sig, handler)
+        except (ValueError, OSError):
+            pass
+
+    def stop_check() -> bool:
+        return state["sig"] is not None
+
+    def finish() -> Optional[int]:
+        done.set()
+        for sig, h in prev.items():
+            try:
+                signal.signal(sig, h)
+            except (ValueError, OSError):
+                pass
+        if state["sig"] is None:
+            return None
+        telemetry.event("preempt.ga_exit", code=EXIT_PREEMPTED)
+        telemetry.flush()
+        log.warning("GA preempted: exiting %d (resume via the same "
+                    "--ga-state / --supervise invocation)",
+                    EXIT_PREEMPTED)
+        return EXIT_PREEMPTED
+
+    return stop_check, finish

@@ -43,6 +43,15 @@ class Workflow(Container):
         self.start_point = StartPoint(self, name="start_point")
         self.end_point = EndPoint(self, name="end_point")
         self.stopped = Bool(False)
+        #: cooperative graceful-stop request (Phoenix): set from a
+        #: signal handler / watchdog thread via ``request_stop()``; the
+        #: run loop honors it at the next ITERATION BOUNDARY (a unit
+        #: flagged ``iteration_boundary`` — the Repeater), where every
+        #: unit has finished the iteration and a snapshot taken there
+        #: resumes exactly like one written by the Snapshotter.  A
+        #: plain bool: assignment is atomic under the GIL and safe from
+        #: signal-handler context (no locks, no allocation).
+        self.stop_requested = False
         self.device = None
         self._max_firings = kwargs.get("max_firings", 10_000_000)
         #: cumulative wall-clock seconds spent inside run() — unlike
@@ -111,10 +120,23 @@ class Workflow(Container):
             raise RuntimeError("workflow.run() before initialize()")
         t_start = time.perf_counter()
         self.stopped.set(False)
+        # a stop requested before (or during a previous) run must not
+        # leak into this one — notably a workflow snapshotted by a
+        # graceful stop carries stop_requested=True on disk, and the
+        # RESUMED run would otherwise stop before its first firing
+        self.stop_requested = False
         queue: collections.deque = collections.deque([self.start_point])
         firings = 0
         while queue and not bool(self.stopped):
             unit = queue.popleft()
+            if self.stop_requested and \
+                    getattr(unit, "iteration_boundary", False):
+                # graceful stop lands HERE: the boundary unit (Repeater)
+                # is about to open the next iteration, so every unit has
+                # completed the current one — identical to the state a
+                # fresh run() reaches right before the same firing,
+                # which is what makes the final snapshot resume exactly
+                break
             if bool(unit.gate_block):
                 continue
             unit._reset_trigger_state()
@@ -136,6 +158,14 @@ class Workflow(Container):
         self.stopped.set(True)
         for u in self.units:
             u.stop()
+
+    def request_stop(self) -> None:
+        """Ask the run loop to stop at the next iteration boundary
+        (see ``stop_requested``).  Unlike ``stop()`` this never fires
+        unit cleanup hooks and leaves the graph in a resumable state —
+        the preemption path (Launcher graceful stop) snapshots right
+        after ``run()`` returns."""
+        self.stop_requested = True
 
     def on_workflow_finished(self) -> None:
         self.report_timings()
@@ -165,6 +195,9 @@ class Workflow(Container):
     def __setstate__(self, state: dict) -> None:
         super().__setstate__(state)
         self.__dict__.setdefault("wall_time", 0.0)
+        # pre-Phoenix snapshots lack the flag; and NEVER carry a stale
+        # request into a resumed run
+        self.stop_requested = False
 
     def generate_data_for_master(self) -> Any:
         return None
@@ -187,6 +220,12 @@ class Repeater(Unit):
     A Repeater fires when ANY predecessor fires (OR semantics), unlike
     normal units (AND semantics).
     """
+
+    #: the Repeater opens each training iteration, so the instant it is
+    #: about to fire is the graceful-stop boundary: loader pointer
+    #: advanced, params updated, decision/snapshotter done — a snapshot
+    #: here resumes exactly (Workflow.run honors stop_requested on it)
+    iteration_boundary = True
 
     @property
     def ready(self) -> bool:
