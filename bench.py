@@ -415,6 +415,26 @@ def fault_drill_metric(phase):
         return None
 
 
+def lint_metric(phase):
+    """Full-repo veleslint scan (veles_tpu/analysis) as a recorded
+    phase: BENCH_r06+ carries the static-analysis record next to the
+    fault drill — zero new findings is an invariant with a measured
+    trajectory, exactly like recovery and performance."""
+    try:
+        from veles_tpu.analysis import repo_scan
+        new, baseline = repo_scan()
+        if new:
+            for f in new[:20]:
+                print(f"veleslint: {f.format()}", file=sys.stderr)
+        phase(f"veleslint: {len(new)} new finding(s), "
+              f"{len(baseline)} baselined")
+        return {"lint_findings_new": len(new),
+                "lint_baseline_count": len(baseline)}
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"veleslint did not run: {e}", file=sys.stderr)
+        return None
+
+
 def ensemble_metric(device, phase):
     """Device-resident ensemble inference (ISSUE 3 tentpole): an
     N-member AlexNet-scale ensemble served as ONE vmapped jitted
@@ -810,11 +830,11 @@ def streaming_metric(device, phase):
         # fused runner's write site feeds the same counter bench used
         # to scrape off the object) — counters are monotonic, so the
         # window accounting below reads deltas
-        from veles_tpu import telemetry
+        from veles_tpu import events, telemetry
 
         def xfer_seconds() -> float:
             return float(telemetry.counter(
-                "fused.stream_transfer_seconds").value)
+                events.CTR_FUSED_STREAM_TRANSFER_SECONDS).value)
         win_req = int(os.environ.get("BENCH_STREAM_WINDOW", "6"))
         win_firings = max(MIN_WINDOW_FIRINGS + 2, win_req)
         if win_firings != win_req:
@@ -1077,6 +1097,8 @@ def main() -> None:
         "fault_drill_hang_detect_sec": None,
         "fault_drill_failures": None,
         "fault_drill_journal_verified": None,
+        "lint_findings_new": None,
+        "lint_baseline_count": None,
         "preempt_snapshot_sec": None,
         "resume_downtime_sec": None,
         "resume_trajectory_match": None,
@@ -1153,6 +1175,12 @@ def main() -> None:
     fd = fault_drill_metric(phase)
     if fd:
         record.update(fd)
+    emit()
+
+    phase("veleslint (full-repo static analysis)")
+    lint = lint_metric(phase)
+    if lint:
+        record.update(lint)
     emit()
 
     phase("running tests_tpu on the chip (in-process)")

@@ -49,6 +49,12 @@ if REPO not in sys.path:
 
 import numpy as np  # noqa: E402
 
+# expected-event names and the exit-code contract come from the
+# shared registries — an emitter/asserter typo is a veleslint
+# finding, not a mystery drill failure
+from veles_tpu import events  # noqa: E402
+from veles_tpu.supervisor import EXIT_MULTIHOST_ABORT  # noqa: E402
+
 
 def log(msg: str) -> None:
     print(f"[chaos] {msg}", file=sys.stderr, flush=True)
@@ -126,10 +132,10 @@ def drill_snapshot__torn_write():
         pass
     got = load_workflow(p2, fallback=True)
     assert got == {"marker": 1}, got
-    ev = assert_journal_event("snapshot.fallback")
+    ev = assert_journal_event(events.EV_SNAPSHOT_FALLBACK)
     assert ev["used"] == p1, ev
     return {"fell_back_to": os.path.basename(p1),
-            "journal_event": "snapshot.fallback"}
+            "journal_event": events.EV_SNAPSHOT_FALLBACK}
 
 
 def drill_checkpoint__corrupt():
@@ -158,10 +164,10 @@ def drill_checkpoint__corrupt():
     _, fit2 = GeneticOptimizer(quad, tunes, population=6,
                                generations=4, state_path=state).run()
     assert abs(fit2 - fit_ref) < 1e-12, (fit2, fit_ref)
-    ev = assert_journal_event("ga.checkpoint_fallback")
+    ev = assert_journal_event(events.EV_GA_CHECKPOINT_FALLBACK)
     assert ev["used"].endswith(".prev"), ev
     return {"bit_identical_resume": True,
-            "journal_event": "ga.checkpoint_fallback"}
+            "journal_event": events.EV_GA_CHECKPOINT_FALLBACK}
 
 
 # -- loader drills -----------------------------------------------------
@@ -208,10 +214,10 @@ def drill_stream__corrupt_file():
         assert "corrupt_tolerance" in str(e)
     finally:
         faults.arm("")
-    assert_journal_event("loader.corrupt_file")
-    assert_journal_event("loader.corrupt_over_tolerance")
+    assert_journal_event(events.EV_LOADER_CORRUPT_FILE)
+    assert_journal_event(events.EV_LOADER_CORRUPT_OVER_TOLERANCE)
     return {"skipped": 1, "threshold_aborted": True,
-            "journal_event": "loader.corrupt_file"}
+            "journal_event": events.EV_LOADER_CORRUPT_FILE}
 
 
 def _tiny_workflow(streaming: bool):
@@ -252,10 +258,10 @@ def drill_device__oom_on_put_stream():
     hist = [h for h in w.decision.history if h["class"] == "validation"]
     assert hist and np.isfinite(hist[-1]["loss"])
     w.stop()
-    ev = assert_journal_event("device.oom_retry")
+    ev = assert_journal_event(events.EV_DEVICE_OOM_RETRY)
     assert ev["site"] == "stream", ev
     return {"oom_retries": 1, "run_completed": True,
-            "journal_event": "device.oom_retry"}
+            "journal_event": events.EV_DEVICE_OOM_RETRY}
 
 
 def drill_device__oom_on_put_resident():
@@ -274,10 +280,10 @@ def drill_device__oom_on_put_resident():
     hist = [h for h in w.decision.history if h["class"] == "validation"]
     assert hist and np.isfinite(hist[-1]["loss"])
     w.stop()
-    ev = assert_journal_event("device.oom_degraded")
+    ev = assert_journal_event(events.EV_DEVICE_OOM_DEGRADED)
     assert ev["site"] == "resident_dataset", ev
     return {"degraded_to_streaming": True,
-            "journal_event": "device.oom_degraded"}
+            "journal_event": events.EV_DEVICE_OOM_DEGRADED}
 
 
 # -- evaluator drills (real serve-mode child process) ------------------
@@ -365,13 +371,13 @@ def drill_evaluator__hang_and_garbage():
     assert pool.hangs_detected >= 1, pool.hangs_detected
     assert pool.last_hang_kind == "heartbeat", pool.last_hang_kind
     assert pool.last_hang_wait <= hb_deadline + 5.0, pool.last_hang_wait
-    ev = assert_journal_event("ga.hang_detected")
+    ev = assert_journal_event(events.EV_GA_HANG_DETECTED)
     assert ev["kind"] == "heartbeat", ev
-    assert_journal_event("ga.evaluator_restart")
+    assert_journal_event(events.EV_GA_EVALUATOR_RESTART)
     return {"hang_detect_sec": round(pool.last_hang_wait, 2),
             "heartbeat_deadline": hb_deadline,
             "fitness_parity": True, "wall_sec": round(wall, 1),
-            "journal_event": "ga.hang_detected"}
+            "journal_event": events.EV_GA_HANG_DETECTED}
 
 
 # -- multihost drill ---------------------------------------------------
@@ -455,8 +461,9 @@ def drill_multihost__peer_exit():
     rc0, err0 = rcs[0]
     rc1, _ = rcs[1]
     assert rc1 == 17, f"peer did not die as injected (rc={rc1})"
-    assert rc0 == 13, \
-        f"survivor rc={rc0}, wanted clean abort 13; stderr: {err0[-800:]}"
+    assert rc0 == EXIT_MULTIHOST_ABORT, \
+        f"survivor rc={rc0}, wanted clean abort " \
+        f"{EXIT_MULTIHOST_ABORT}; stderr: {err0[-800:]}"
     assert "aborting cleanly" in err0, err0[-800:]
     snaps = []
     for root, _, files in os.walk(d):
@@ -471,11 +478,11 @@ def drill_multihost__peer_exit():
     # just recovery
     from veles_tpu import telemetry
     mdir = telemetry.metrics_dir()
-    evs = journal_events_from_dir(mdir, "multihost.emergency_snapshot") \
-        if mdir else []
+    evs = journal_events_from_dir(
+        mdir, events.EV_MULTIHOST_EMERGENCY_SNAPSHOT) if mdir else []
     assert evs, "survivor journal lacks the abort record"
     return {"survivor_exit": rc0, "final_snapshot": snaps[0],
-            "journal_event": "multihost.emergency_snapshot"}
+            "journal_event": events.EV_MULTIHOST_EMERGENCY_SNAPSHOT}
 
 
 # -- Phoenix drills (preemption + supervisor) --------------------------
@@ -586,22 +593,23 @@ def drill_preempt__sigterm_resume():
     assert snaps, os.listdir(os.path.join(d, "snaps"))
     # journal: requested -> final snapshot (inside grace, never the
     # watchdog's hard path) -> supervisor resumed -> done
-    req = journal_events_from_dir(mdir, "preempt.requested")
-    fin = journal_events_from_dir(mdir, "preempt.final_snapshot")
+    req = journal_events_from_dir(mdir, events.EV_PREEMPT_REQUESTED)
+    fin = journal_events_from_dir(mdir,
+                                  events.EV_PREEMPT_FINAL_SNAPSHOT)
     assert req and fin, journal_events_from_dir(mdir)
-    assert not journal_events_from_dir(mdir,
-                                       "preempt.deadline_exceeded")
+    assert not journal_events_from_dir(
+        mdir, events.EV_PREEMPT_DEADLINE_EXCEEDED)
     snapshot_sec = fin[-1]["ts"] - req[-1]["ts"]
     assert 0 <= snapshot_sec <= grace, snapshot_sec
-    resumed = journal_events_from_dir(mdir, "supervisor.resumed")
+    resumed = journal_events_from_dir(mdir, events.EV_SUPERVISOR_RESUMED)
     assert resumed and resumed[-1]["source"] == "snapshot", resumed
-    assert journal_events_from_dir(mdir, "supervisor.done")
+    assert journal_events_from_dir(mdir, events.EV_SUPERVISOR_DONE)
 
     # trajectory parity: f32-exact on CPU, incl. the weight checksum
     match = got["hist"] == ref["hist"] and got["wsum"] == ref["wsum"]
     assert match, (got["epochs"], ref["epochs"], got["wsum"],
                    ref["wsum"])
-    return {"journal_event": "preempt.final_snapshot",
+    return {"journal_event": events.EV_PREEMPT_FINAL_SNAPSHOT,
             "trajectory_match": True,
             "preempt_snapshot_sec": round(snapshot_sec, 2),
             "resume_downtime_sec": resumed[-1].get("downtime"),
@@ -647,12 +655,13 @@ def drill_supervisor__sigkill_ga_resume():
     with open(os.path.join(d, "state.json")) as f:
         st_got = json.load(f)
     assert st_got == st_ref, "resumed GA checkpoint diverged"
-    restarts = journal_events_from_dir(mdir, "supervisor.restart")
+    restarts = journal_events_from_dir(mdir,
+                                       events.EV_SUPERVISOR_RESTART)
     assert restarts and restarts[-1]["kind"] == "crash", restarts
-    resumed = journal_events_from_dir(mdir, "supervisor.resumed")
+    resumed = journal_events_from_dir(mdir, events.EV_SUPERVISOR_RESUMED)
     assert resumed and resumed[-1]["source"] == "ga_state", resumed
-    assert journal_events_from_dir(mdir, "ga.resumed")
-    return {"journal_event": "supervisor.resumed",
+    assert journal_events_from_dir(mdir, events.EV_GA_RESUMED)
+    return {"journal_event": events.EV_SUPERVISOR_RESUMED,
             "bit_identical_resume": True,
             "resume_downtime_sec": resumed[-1].get("downtime")}
 

@@ -1,0 +1,26 @@
+#!/usr/bin/env python3
+"""Run veleslint (veles_tpu/analysis) from a source checkout.
+
+Usage::
+
+    python scripts/veleslint.py                  # full-repo scan
+    python scripts/veleslint.py --rule atomic-write
+    python scripts/veleslint.py --sync-docs      # regen knob table
+    python scripts/veleslint.py --write-baseline
+
+See docs/guide.md section 10 for the rule catalog, waiver syntax, and
+the baseline workflow.  The installed console entry point
+(``veleslint``) is the same program.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from veles_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

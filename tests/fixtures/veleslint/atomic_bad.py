@@ -1,0 +1,18 @@
+"""Seeded atomic-write violations (veleslint fixture)."""
+import json
+
+
+def save_state(path, payload):
+    with open(path, "w") as f:          # finding: bare text write
+        json.dump(payload, f)
+
+
+def save_blob(path, blob):
+    f = open(path, "wb")                # finding: bare binary write
+    f.write(blob)
+    f.close()
+
+
+def save_kw(path, blob):
+    with open(path, mode="w+") as f:    # finding: mode keyword
+        f.write(blob)

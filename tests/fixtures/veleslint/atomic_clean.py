@@ -1,0 +1,27 @@
+"""Clean counterpart of atomic_bad (veleslint fixture)."""
+import json
+import os
+import tempfile
+
+
+def load_state(path):
+    with open(path) as f:               # reads are fine
+        return json.load(f)
+
+
+def load_blob(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save_state(path, payload):
+    from veles_tpu.snapshotter import atomic_write
+    with atomic_write(path, "w") as f:  # the hardened helper
+        json.dump(payload, f)
+
+
+def save_raw(path, blob):
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+    with os.fdopen(fd, "wb") as f:      # tempfile dance inline
+        f.write(blob)
+    os.replace(tmp, path)

@@ -1,0 +1,20 @@
+"""Seeded env-registry violations (veleslint fixture)."""
+import os
+
+_TYPO_ENV = "VELES_PREEMPT_GRAEC"
+
+
+def read_undeclared():
+    return os.environ.get("VELES_NOT_A_KNOB")       # finding
+
+
+def read_typo():
+    return os.environ.get(_TYPO_ENV, "25")          # finding (const)
+
+
+def write_undeclared():
+    os.environ["VELES_ALSO_UNDECLARED"] = "1"       # finding
+
+
+def getenv_undeclared():
+    return os.getenv("VELES_MYSTERY_FLAG")          # finding

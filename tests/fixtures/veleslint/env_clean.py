@@ -1,0 +1,26 @@
+"""Clean counterpart of env_bad (veleslint fixture)."""
+import os
+
+GRACE_ENV = "VELES_PREEMPT_GRACE"
+
+
+class Runner:
+    FAULTS_ENV = "VELES_FAULTS"
+
+    def grace(self):
+        return float(os.environ.get(GRACE_ENV, "25"))   # declared
+
+    def faults(self):
+        return os.environ.get(self.FAULTS_ENV, "")      # class const
+
+
+def metrics_dir():
+    return os.environ.get("VELES_METRICS_DIR")          # declared
+
+
+def non_veles():
+    return os.environ.get("JAX_PLATFORMS")              # out of scope
+
+
+def dynamic(name):
+    return os.environ.get(name)                         # unresolvable

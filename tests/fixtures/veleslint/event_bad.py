@@ -1,0 +1,15 @@
+"""Seeded event-registry violations (veleslint fixture)."""
+from veles_tpu import telemetry
+
+
+def hang(kind):
+    # finding: declared name, but as an ad-hoc literal
+    telemetry.event("ga.hang_detected", kind=kind)
+    # finding: a TYPO no registry entry matches — the class of bug
+    # chaos_drill assertions could previously only catch at runtime
+    telemetry.counter("ga.hangs_detcted").inc()
+    telemetry.gauge("ga.last_hang_wait").set(1.0)       # finding
+    telemetry.histogram("ga.genome_seconds").record(2)  # finding
+    with telemetry.span("ga.cohort_train"):             # finding
+        pass
+    return telemetry.recent_events("ga.hang_detected")  # finding

@@ -1,0 +1,19 @@
+"""Clean counterpart of event_bad (veleslint fixture)."""
+from veles_tpu import events, telemetry
+
+
+def hang(kind):
+    telemetry.event(events.EV_GA_HANG_DETECTED, kind=kind)
+    telemetry.counter(events.CTR_GA_HANGS_DETECTED).inc()
+    telemetry.gauge(events.GAUGE_GA_LAST_HANG_WAIT).set(1.0)
+    telemetry.histogram(events.HIST_GA_GENOME_SECONDS).record(2)
+    with telemetry.span(events.SPAN_GA_COHORT_TRAIN):
+        pass
+    return telemetry.recent_events(events.EV_GA_HANG_DETECTED)
+
+
+def dynamic(kind):
+    # f-strings and variables are the documented dynamic families
+    telemetry.counter(f"fused.{kind}_seconds").inc(1.0)
+    name = events.EV_GA_GENERATION
+    telemetry.event(name, gen=1)

@@ -1,0 +1,19 @@
+"""Seeded exit-code-literals violations (veleslint fixture)."""
+import os
+import sys
+
+
+def abort():
+    os._exit(13)                        # finding: exit-call literal
+
+
+def preempt():
+    sys.exit(14)                        # finding: exit-call literal
+
+
+def classify(rc):
+    if rc == 14:                        # finding: comparison literal
+        return "preempted"
+    if rc in (13, 14):                  # findings: both comparators
+        return "resume"
+    return "crash"

@@ -1,0 +1,25 @@
+"""Clean counterpart of exit_bad (veleslint fixture)."""
+import os
+import sys
+
+EXIT_MULTIHOST_ABORT = 13   # constant definitions are the source
+EXIT_PREEMPTED = 14
+RESUME_CODES = frozenset((EXIT_MULTIHOST_ABORT, EXIT_PREEMPTED))
+
+
+def abort():
+    os._exit(EXIT_MULTIHOST_ABORT)
+
+
+def preempt():
+    sys.exit(EXIT_PREEMPTED)
+
+
+def classify(rc):
+    if rc == EXIT_PREEMPTED:
+        return "preempted"
+    if rc in RESUME_CODES:
+        return "resume"
+    if rc == 17:                # a non-contract code stays a number
+        return "drill"
+    return "crash"

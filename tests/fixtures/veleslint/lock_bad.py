@@ -1,0 +1,25 @@
+"""Seeded lock-discipline violations (veleslint fixture)."""
+import threading
+from collections import deque
+
+_lock = threading.Lock()
+_jobs = {}
+_queue = deque()
+_seen: list = []
+
+_jobs["boot"] = 1      # import-time mutation: exempt (no threads yet)
+
+
+def submit(job_id, payload):
+    _jobs[job_id] = payload             # finding: setitem, no lock
+    _queue.append(job_id)               # finding: append, no lock
+
+
+def drain():
+    while _queue:
+        _seen.append(_queue.popleft())  # findings: append + popleft
+    _jobs.clear()                       # finding: clear, no lock
+
+
+def worker():
+    threading.Thread(target=drain).start()

@@ -1,0 +1,34 @@
+"""Clean counterpart of lock_bad (veleslint fixture)."""
+import threading
+from collections import deque
+
+_lock = threading.Lock()
+_jobs = {}
+_queue = deque()
+
+
+class Pool:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.jobs = {}
+
+    def submit(self, job_id, payload):
+        # instance state is the owner's concern, not this rule's
+        self.jobs[job_id] = payload
+
+
+def submit(job_id, payload):
+    with _lock:
+        _jobs[job_id] = payload
+        _queue.append(job_id)
+
+
+def drain():
+    with _lock:
+        while _queue:
+            _queue.popleft()
+        _jobs.clear()
+
+
+def worker():
+    threading.Thread(target=drain).start()

@@ -1,0 +1,37 @@
+"""Seeded tracer-hygiene violations (veleslint fixture)."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_sync(x):
+    v = x.sum().item()                  # finding: .item()
+    print("loss", v)                    # finding: print
+    return x * v
+
+
+@partial(jax.jit, static_argnums=(1,))
+def partial_decorated(x, n):
+    host = np.asarray(x)                # finding: np.asarray
+    return x + host.shape[0] + n
+
+
+def passed_to_jit(params, lr):
+    if jnp.any(jnp.isnan(params)):      # finding: branch on jnp value
+        return params
+    step = float(lr)                    # finding: float(param)
+    return params - step * params
+
+
+_step = jax.jit(passed_to_jit, donate_argnums=(0,))
+
+
+def vmapped(row):
+    row.block_until_ready()             # finding: device sync
+    return row * 2
+
+
+_v = jax.vmap(vmapped)

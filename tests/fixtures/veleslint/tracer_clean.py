@@ -1,0 +1,27 @@
+"""Clean counterpart of tracer_bad (veleslint fixture)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def pure_step(params, grads, lr):
+    # jnp control flow stays in-graph; shapes are static python
+    upd = jnp.where(jnp.isnan(grads), 0.0, grads)
+    k = int(params.shape[0])            # static shape: fine
+    return params - lr * upd / k
+
+
+def traced_scan(carry, x):
+    return carry + x, carry
+
+
+_step = jax.jit(pure_step)
+
+
+def host_side(arr):
+    # host code may sync freely — the rule only bites inside traced
+    # functions
+    v = arr.sum().item()
+    print("host", v)
+    return np.asarray(arr)

@@ -1,0 +1,22 @@
+"""Inline-waiver fixture: every violation here carries a disable
+comment, so a scan must come back clean."""
+import json
+import os
+
+from veles_tpu import telemetry
+
+
+def save_state(path, payload):
+    # scratch file rewritten every run; a tear is self-healing
+    with open(path, "w") as f:  # veleslint: disable=atomic-write
+        json.dump(payload, f)
+
+
+def read_knob():
+    # experiment-local override, deliberately unregistered
+    return os.environ.get(
+        "VELES_SCRATCH_ONLY")  # veleslint: disable=env-registry
+
+
+def emit():
+    telemetry.event("ga.hang_detected")  # veleslint: disable

@@ -1,0 +1,33 @@
+"""Veleslint: repo-specific AST static analysis.
+
+PRs 6-8 hardened this codebase around invariants that, until this
+package, lived only in convention: persistent state writes must be
+atomic (tempfile + ``os.replace``), every ``VELES_*`` env knob must be
+declared in the central registry (veles_tpu/knobs.py), telemetry
+journal/metric names must be declared constants (veles_tpu/events.py),
+traced functions must not host-sync, the 13/14 exit-code contract must
+flow from the named constants, and module-level mutable state in the
+thread-spawning modules must be mutated under a lock.  Veleslint turns
+each invariant into a machine-checked rule that runs in tier-1
+(tests/test_veleslint.py) and as the ``veleslint`` CLI
+(scripts/veleslint.py), with inline ``# veleslint: disable=<rule>``
+waivers and a checked-in baseline (analysis/baseline.json) for
+justified grandfathered findings.
+
+See docs/guide.md section 10 for the rule catalog and workflow.
+"""
+
+from veles_tpu.analysis.engine import (  # noqa: F401
+    Config,
+    Finding,
+    check_knob_table,
+    load_baseline,
+    load_config,
+    new_findings,
+    repo_root,
+    repo_scan,
+    run_lint,
+    scan_source,
+    write_baseline,
+)
+from veles_tpu.analysis.rules import RULES, rule_names  # noqa: F401

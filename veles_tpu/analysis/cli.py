@@ -1,0 +1,104 @@
+"""The ``veleslint`` command line (also ``scripts/veleslint.py``).
+
+Exit codes: 0 clean (no non-baselined finding), 1 new findings, 2 a
+usage/config/baseline error (e.g. a baseline entry without a written
+justification).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from veles_tpu.analysis import engine, rules as rules_mod
+    p = argparse.ArgumentParser(
+        prog="veleslint",
+        description="repo-specific AST invariant checks "
+                    "(docs/guide.md section 10)")
+    p.add_argument("--root", default=None,
+                   help="repository root (default: autodetected)")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="NAME", choices=rules_mod.rule_names(),
+                   help="run only this rule (repeatable)")
+    p.add_argument("--format", choices=("text", "json"),
+                   default="text")
+    p.add_argument("--all", action="store_true",
+                   help="report every finding, baselined ones "
+                        "included (marked)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="grandfather the current findings into the "
+                        "baseline file; new entries get a TODO "
+                        "justification that MUST be hand-edited "
+                        "(the loader refuses TODOs)")
+    p.add_argument("--sync-docs", action="store_true",
+                   help="regenerate the VELES_* knob table in "
+                        "docs/guide.md from veles_tpu/knobs.py")
+    p.add_argument("--no-docs-check", action="store_true",
+                   help="skip the guide knob-table sync check")
+    args = p.parse_args(argv)
+
+    root = args.root or engine.repo_root()
+    try:
+        config = engine.load_config(root)
+    except ValueError as e:
+        print(f"veleslint: {e}", file=sys.stderr)
+        return 2
+
+    if args.sync_docs:
+        guide = engine.sync_knob_table(root, config)
+        print(f"veleslint: knob table regenerated in {guide}")
+        return 0
+
+    baseline_path = os.path.join(root, config.baseline)
+    try:
+        baseline = engine.load_baseline(baseline_path)
+    except ValueError as e:
+        print(f"veleslint: {e}", file=sys.stderr)
+        return 2
+
+    findings = engine.run_lint(root, config, rules=args.rule,
+                               check_docs=not args.no_docs_check)
+
+    if args.write_baseline:
+        engine.write_baseline(baseline_path, findings, baseline)
+        print(f"veleslint: {len(findings)} finding(s) written to "
+              f"{baseline_path}; edit every TODO justification "
+              "before committing")
+        return 0
+
+    new = engine.new_findings(findings, baseline)
+    shown = findings if args.all else new
+    if args.format == "json":
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "baselined": len(findings) - len(new),
+            "baseline_total": len(baseline),
+        }, indent=1))
+    else:
+        for f in shown:
+            tag = "" if f.key not in baseline else " (baselined)"
+            print(f.format() + tag)
+        # staleness is only decidable from a full-rule scan: a
+        # --rule run never produces the other rules' findings
+        stale = [] if args.rule else \
+            [k for k in baseline
+             if k not in {f.key for f in findings}]
+        if stale:
+            print(f"veleslint: note: {len(stale)} stale baseline "
+                  "entr(y/ies) no longer found — prune them:",
+                  file=sys.stderr)
+            for k in stale:
+                print(f"  {k}", file=sys.stderr)
+        print(f"veleslint: {len(new)} new finding(s), "
+              f"{len(findings) - len(new)} baselined, "
+              f"{len(baseline)} baseline entr(y/ies)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

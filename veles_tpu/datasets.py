@@ -81,8 +81,9 @@ def _read_idx(path: str) -> np.ndarray:
 def write_idx(path: str, array: np.ndarray) -> None:
     """Write an array in IDX format (the MNIST container: big-endian
     magic = dtype 0x08 (ubyte) + ndim, then dims, then raw bytes)."""
+    from veles_tpu.snapshotter import atomic_write
     arr = np.ascontiguousarray(array, np.uint8)
-    with open(path, "wb") as f:
+    with atomic_write(path, "wb") as f:
         f.write(struct.pack(">I", 0x0800 | arr.ndim))
         for d in arr.shape:
             f.write(struct.pack(">I", d))
@@ -439,12 +440,14 @@ def prepare_imagenet(source: str, out_dir: str,
     if counts["train"]:
         mean = (mean_acc / counts["train"]).astype(np.float32)
         np.save(os.path.join(out, "mean_image.npy"), mean)
-    with open(os.path.join(out, "labels.json"), "w") as f:
+    from veles_tpu.snapshotter import atomic_write
+    with atomic_write(os.path.join(out, "labels.json"), "w") as f:
         _json.dump(label_of, f, indent=1, sort_keys=True)
     manifest = {"image_size": image_size, "n_classes": len(class_names),
                 "counts": counts, "source": source,
                 "mean_image": bool(counts["train"])}
-    with open(os.path.join(out, "manifest.json"), "w") as f:
+    with atomic_write(os.path.join(out, "manifest.json"),
+                      "w") as f:
         _json.dump(manifest, f, indent=1)
     if extracted is not None:
         shutil.rmtree(extracted, ignore_errors=True)

@@ -1,0 +1,195 @@
+"""The central registry of Sightline telemetry names.
+
+Every journal event, counter, gauge, histogram, and span name this
+framework emits is declared HERE as an importable constant.  Call
+sites use the constants (``telemetry.event(events.EV_SNAPSHOT_SAVE,
+...)``), and veleslint's event-registry rule flags any ad-hoc string
+literal passed to ``telemetry.event / counter / gauge / histogram /
+span / recent_events`` — the typo class chaos_drill's journal
+assertions could previously only catch at runtime (an emitter and an
+asserter disagreeing on a name means the drill reads an event that
+never fires) is now a parse-time finding.
+
+A few hot-path names are *families* keyed by the fused step kind and
+are necessarily built dynamically (``fused.<kind>_dispatch_seconds``
+histograms, ``fused.first_<kind>_dispatch_seconds`` gauges,
+``fused.<kind>_seconds`` / ``fused.<kind>_images`` counters); the lint
+rule checks literals only, and the families are documented here so the
+registry stays the one place a name is looked up.
+"""
+
+from __future__ import annotations
+
+from typing import Set
+
+EVENTS: Set[str] = set()
+COUNTERS: Set[str] = set()
+GAUGES: Set[str] = set()
+HISTOGRAMS: Set[str] = set()
+SPANS: Set[str] = set()
+
+
+def _ev(name: str) -> str:
+    EVENTS.add(name)
+    return name
+
+
+def _ctr(name: str) -> str:
+    COUNTERS.add(name)
+    return name
+
+
+def _gauge(name: str) -> str:
+    GAUGES.add(name)
+    return name
+
+
+def _hist(name: str) -> str:
+    HISTOGRAMS.add(name)
+    return name
+
+
+def _span(name: str) -> str:
+    # a journaled span emits an event AND feeds the histogram of the
+    # same name — it lives in every namespace it touches
+    SPANS.add(name)
+    EVENTS.add(name)
+    HISTOGRAMS.add(name)
+    return name
+
+
+# -- journal events ----------------------------------------------------
+
+EV_FUSED_FIRST_DISPATCH = _ev("fused.first_dispatch")
+EV_FUSED_SUMMARY = _ev("fused.summary")
+
+EV_DEVICE_OOM_RETRY = _ev("device.oom_retry")
+EV_DEVICE_OOM_DEGRADED = _ev("device.oom_degraded")
+
+EV_SNAPSHOT_SAVE = _ev("snapshot.save")
+EV_SNAPSHOT_FALLBACK = _ev("snapshot.fallback")
+EV_SNAPSHOT_UNRECOVERABLE = _ev("snapshot.unrecoverable")
+
+EV_LOADER_EPOCH = _ev("loader.epoch")
+EV_LOADER_CORRUPT_FILE = _ev("loader.corrupt_file")
+EV_LOADER_CORRUPT_OVER_TOLERANCE = _ev("loader.corrupt_over_tolerance")
+
+EV_GA_GENERATION = _ev("ga.generation")
+EV_GA_GENERATION_EVALUATED = _ev("ga.generation_evaluated")
+EV_GA_HANG_DETECTED = _ev("ga.hang_detected")
+EV_GA_EVALUATOR_RESTART = _ev("ga.evaluator_restart")
+EV_GA_GENOME_LOST = _ev("ga.genome_lost")
+EV_GA_GENOME_RETRY = _ev("ga.genome_retry")
+EV_GA_CHECKPOINT_FALLBACK = _ev("ga.checkpoint_fallback")
+EV_GA_CHECKPOINT_UNRECOVERABLE = _ev("ga.checkpoint_unrecoverable")
+EV_GA_RESUMED = _ev("ga.resumed")
+
+EV_PREEMPT_REQUESTED = _ev("preempt.requested")
+EV_PREEMPT_DEADLINE_EXCEEDED = _ev("preempt.deadline_exceeded")
+EV_PREEMPT_FINAL_SNAPSHOT = _ev("preempt.final_snapshot")
+EV_PREEMPT_PEER_BROADCAST = _ev("preempt.peer_broadcast")
+EV_PREEMPT_GA_STOP = _ev("preempt.ga_stop")
+EV_PREEMPT_GA_EXIT = _ev("preempt.ga_exit")
+
+EV_MULTIHOST_EMERGENCY_SNAPSHOT = _ev("multihost.emergency_snapshot")
+EV_MULTIHOST_COLLECTIVE_FAILED = _ev("multihost.collective_failed")
+EV_MULTIHOST_PEER_DEATH = _ev("multihost.peer_death")
+EV_MULTIHOST_INIT_REFUSED = _ev("multihost.init_refused")
+
+EV_SUPERVISOR_RESTART = _ev("supervisor.restart")
+EV_SUPERVISOR_RESUMED = _ev("supervisor.resumed")
+EV_SUPERVISOR_SHUTDOWN = _ev("supervisor.shutdown")
+EV_SUPERVISOR_DONE = _ev("supervisor.done")
+EV_SUPERVISOR_GIVEUP = _ev("supervisor.giveup")
+
+# -- counters ----------------------------------------------------------
+
+CTR_FUSED_DISPATCHES = _ctr("fused.dispatches")
+CTR_FUSED_MINIBATCHES = _ctr("fused.minibatches")
+CTR_FUSED_STREAM_TRANSFER_BYTES = _ctr("fused.stream_transfer_bytes")
+CTR_FUSED_STREAM_TRANSFER_SECONDS = _ctr(
+    "fused.stream_transfer_seconds")
+CTR_FUSED_STREAM_OOM_RETRIES = _ctr("fused.stream_oom_retries")
+
+CTR_ENSEMBLE_CHUNKS = _ctr("ensemble.chunks")
+CTR_ENSEMBLE_SECONDS = _ctr("ensemble.seconds")
+CTR_ENSEMBLE_IMAGES = _ctr("ensemble.images")
+CTR_ENSEMBLE_MEMBER_IMAGES = _ctr("ensemble.member_images")
+
+CTR_GA_COHORTS = _ctr("ga.cohorts")
+CTR_GA_COHORT_MEMBERS = _ctr("ga.cohort_members")
+CTR_GA_EVALUATIONS = _ctr("ga.evaluations")
+CTR_GA_EVAL_SECONDS = _ctr("ga.eval_seconds")
+CTR_GA_HANGS_DETECTED = _ctr("ga.hangs_detected")
+CTR_GA_EVALUATOR_RESTARTS = _ctr("ga.evaluator_restarts")
+CTR_GA_GENOMES_LOST = _ctr("ga.genomes_lost")
+CTR_GA_GENOME_RETRIES = _ctr("ga.genome_retries")
+CTR_GA_CHECKPOINT_FALLBACKS = _ctr("ga.checkpoint_fallbacks")
+
+CTR_EVALUATOR_JOBS = _ctr("evaluator.jobs")
+CTR_EVALUATOR_JOB_ERRORS = _ctr("evaluator.job_errors")
+
+CTR_LOADER_EPOCHS = _ctr("loader.epochs")
+CTR_LOADER_IMAGES_DECODED = _ctr("loader.images_decoded")
+CTR_LOADER_CORRUPT_SKIPPED = _ctr("loader.corrupt_skipped")
+
+CTR_SNAPSHOT_SAVES = _ctr("snapshot.saves")
+CTR_SNAPSHOT_FALLBACKS = _ctr("snapshot.fallbacks")
+
+CTR_DEVICE_OOM_DEGRADED = _ctr("device.oom_degraded")
+CTR_MULTIHOST_EMERGENCY_SNAPSHOTS = _ctr(
+    "multihost.emergency_snapshots")
+CTR_PREEMPT_FINAL_SNAPSHOTS = _ctr("preempt.final_snapshots")
+CTR_SUPERVISOR_RESTARTS = _ctr("supervisor.restarts")
+
+# -- gauges ------------------------------------------------------------
+
+GAUGE_FUSED_MFU = _gauge("fused.mfu")
+GAUGE_FUSED_TRAIN_GFLOPS_PER_IMAGE = _gauge(
+    "fused.train_gflops_per_image")
+GAUGE_FUSED_TRAIN_IMAGES_PER_SEC_WALL = _gauge(
+    "fused.train_images_per_sec_wall")
+GAUGE_GA_LAST_HANG_WAIT = _gauge("ga.last_hang_wait")
+GAUGE_PREEMPT_SNAPSHOT_SECONDS = _gauge("preempt.snapshot_seconds")
+GAUGE_MULTIHOST_PEER_HEARTBEAT_AGE = _gauge(
+    "multihost.peer_heartbeat_age")
+
+# -- histograms --------------------------------------------------------
+
+HIST_SNAPSHOT_SAVE_SECONDS = _hist("snapshot.save_seconds")
+HIST_SNAPSHOT_LOAD_SECONDS = _hist("snapshot.load_seconds")
+HIST_GA_GENOME_SECONDS = _hist("ga.genome_seconds")
+HIST_GA_GENERATION_SECONDS = _hist("ga.generation_seconds")
+HIST_LOADER_DECODE_SECONDS = _hist("loader.decode_seconds")
+HIST_LOADER_EPOCH_SECONDS = _hist("loader.epoch_seconds")
+HIST_ENSEMBLE_DISPATCH_SECONDS = _hist("ensemble.dispatch_seconds")
+HIST_ENSEMBLE_SCORE_SECONDS = _hist("ensemble.score_seconds")
+HIST_SUPERVISOR_DOWNTIME_SECONDS = _hist(
+    "supervisor.downtime_seconds")
+
+# -- journaled spans (event + histogram of the same name) --------------
+
+SPAN_GA_COHORT_TRAIN = _span("ga.cohort_train")
+SPAN_EVALUATOR_JOB_SECONDS = _span("evaluator.job_seconds")
+
+#: dynamic name families (built with f-strings at the call site; the
+#: lint rule checks literals only): ``fused.<kind>_dispatch_seconds``
+#: histograms, ``fused.first_<kind>_dispatch_seconds`` gauges, and
+#: ``fused.<kind>_seconds`` / ``fused.<kind>_images`` counters, where
+#: <kind> is the fused step kind (train/eval/...)
+DYNAMIC_FAMILIES = (
+    "fused.<kind>_dispatch_seconds",
+    "fused.first_<kind>_dispatch_seconds",
+    "fused.<kind>_seconds",
+    "fused.<kind>_images",
+)
+
+
+def known(name: str) -> bool:
+    """Is ``name`` declared in any telemetry namespace?"""
+    return name in EVENTS or name in COUNTERS or name in GAUGES \
+        or name in HISTOGRAMS or name in SPANS
+
+
+def all_names() -> frozenset:
+    return frozenset(EVENTS | COUNTERS | GAUGES | HISTOGRAMS | SPANS)

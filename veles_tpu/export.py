@@ -113,7 +113,8 @@ def export_model(workflow, path: str) -> str:
         fused.sync_params_to_vectors()  # pull trained HBM state to host
     in_shape = tuple(forwards[0].input.shape[1:])
     records = [_op_record(u) for u in forwards]
-    with open(path, "wb") as f:
+    from veles_tpu.snapshotter import atomic_write
+    with atomic_write(path, "wb") as f:
         f.write(MAGIC)
         f.write(struct.pack("<II", VERSION, len(records)))
         f.write(struct.pack("<q", len(in_shape)))

@@ -6,7 +6,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu import prng, telemetry
+from veles_tpu import events, prng, telemetry
 from veles_tpu.config import Config
 from veles_tpu.logger import Logger
 
@@ -256,10 +256,10 @@ class GeneticOptimizer(Logger):
         #: ``ga.eval_seconds``) plus the per-round distribution
         self.eval_count += len(genomes)
         self.eval_seconds += dt
-        telemetry.counter("ga.evaluations").inc(len(genomes))
-        telemetry.counter("ga.eval_seconds").inc(dt)
-        telemetry.histogram("ga.generation_seconds").record(dt)
-        telemetry.event("ga.generation_evaluated", gen=gen,
+        telemetry.counter(events.CTR_GA_EVALUATIONS).inc(len(genomes))
+        telemetry.counter(events.CTR_GA_EVAL_SECONDS).inc(dt)
+        telemetry.histogram(events.HIST_GA_GENERATION_SECONDS).record(dt)
+        telemetry.event(events.EV_GA_GENERATION_EVALUATED, gen=gen,
                         genomes=len(genomes), seconds=round(dt, 2))
         if dt > 0:
             self.info("evaluated %d genomes in %.1fs (%.2f genomes/s)",
@@ -454,14 +454,14 @@ class GeneticOptimizer(Logger):
                              "trying predecessor", path, e)
                 continue
             if path != self.state_path:
-                telemetry.counter("ga.checkpoint_fallbacks").inc()
-                telemetry.event("ga.checkpoint_fallback",
+                telemetry.counter(events.CTR_GA_CHECKPOINT_FALLBACKS).inc()
+                telemetry.event(events.EV_GA_CHECKPOINT_FALLBACK,
                                 corrupt=self.state_path, used=path)
                 self.warning("resuming from intact predecessor %s",
                              path)
             break
         if state is None:
-            telemetry.event("ga.checkpoint_unrecoverable",
+            telemetry.event(events.EV_GA_CHECKPOINT_UNRECOVERABLE,
                             path=self.state_path)
             raise SnapshotCorruptError(
                 f"GA checkpoint {self.state_path} and its .prev "
@@ -493,7 +493,7 @@ class GeneticOptimizer(Logger):
         resumed = self._load_state()
         if resumed is not None:
             start_gen, pop, fits = resumed
-            telemetry.event("ga.resumed", generation=start_gen,
+            telemetry.event(events.EV_GA_RESUMED, generation=start_gen,
                             state=self.state_path)
             self.info("resumed GA at generation %d from %s",
                       start_gen, self.state_path)
@@ -512,7 +512,7 @@ class GeneticOptimizer(Logger):
                 # checkpoint written after the previous generation is
                 # the resume point; a resumed run continues the
                 # remaining generations bit-identically
-                telemetry.event("preempt.ga_stop", generation=gen)
+                telemetry.event(events.EV_PREEMPT_GA_STOP, generation=gen)
                 self.warning(
                     "graceful stop: breeding halted before generation "
                     "%d; resume continues from the checkpoint", gen)
@@ -521,7 +521,7 @@ class GeneticOptimizer(Logger):
             pop, fits = pop[order], fits[order]
             self.history.append([(float(f), self._decode(g))
                                  for f, g in zip(fits, pop)])
-            telemetry.event("ga.generation", gen=gen,
+            telemetry.event(events.EV_GA_GENERATION, gen=gen,
                             best=float(fits[0]))
             self.info("generation %d: best=%.4f %s", gen, fits[0],
                       self._decode(pop[0]))

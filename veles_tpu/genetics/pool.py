@@ -53,7 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu import telemetry
+from veles_tpu import events, telemetry
 from veles_tpu.logger import Logger
 
 
@@ -123,9 +123,9 @@ class ChipEvaluatorPool(Logger):
         #: fields describe the CURRENT generation only (reset by
         #: ``_begin_generation``)
         self._hangs_base = telemetry.counter(
-            "ga.hangs_detected").value
+            events.CTR_GA_HANGS_DETECTED).value
         self._restarts_base = telemetry.counter(
-            "ga.evaluator_restarts").value
+            events.CTR_GA_EVALUATOR_RESTARTS).value
         self.last_hang_wait: Optional[float] = None
         self.last_hang_kind: Optional[str] = None
         self._consecutive_restarts = 0
@@ -135,12 +135,13 @@ class ChipEvaluatorPool(Logger):
 
     @property
     def hangs_detected(self) -> int:
-        return max(0, telemetry.counter("ga.hangs_detected").value
-                   - self._hangs_base)
+        return max(0, telemetry.counter(
+            events.CTR_GA_HANGS_DETECTED).value - self._hangs_base)
 
     @property
     def restarts(self) -> int:
-        return max(0, telemetry.counter("ga.evaluator_restarts").value
+        return max(0, telemetry.counter(
+            events.CTR_GA_EVALUATOR_RESTARTS).value
                    - self._restarts_base)
 
     def _note_hang(self, kind: str, wait: float) -> None:
@@ -149,9 +150,10 @@ class ChipEvaluatorPool(Logger):
         that a hung evaluator was caught, how, and how fast."""
         self.last_hang_kind = kind
         self.last_hang_wait = wait
-        telemetry.counter("ga.hangs_detected").inc()
-        telemetry.gauge("ga.last_hang_wait").set(round(wait, 3))
-        telemetry.event("ga.hang_detected", kind=kind,
+        telemetry.counter(events.CTR_GA_HANGS_DETECTED).inc()
+        telemetry.gauge(events.GAUGE_GA_LAST_HANG_WAIT).set(
+            round(wait, 3))
+        telemetry.event(events.EV_GA_HANG_DETECTED, kind=kind,
                         wait=round(wait, 3))
 
     def _begin_generation(self) -> None:
@@ -195,10 +197,10 @@ class ChipEvaluatorPool(Logger):
         """Restart after a death/hang, with exponential backoff +
         deterministic jitter once restarts come consecutively (a
         crash-looping evaluator must not storm the host)."""
-        telemetry.counter("ga.evaluator_restarts").inc()
+        telemetry.counter(events.CTR_GA_EVALUATOR_RESTARTS).inc()
         self._consecutive_restarts += 1
         n = self._consecutive_restarts
-        telemetry.event("ga.evaluator_restart", consecutive=n)
+        telemetry.event(events.EV_GA_EVALUATOR_RESTART, consecutive=n)
         if n > 1:
             delay = min(self.restart_backoff_cap,
                         self.restart_backoff * (2.0 ** (n - 2)))
@@ -350,7 +352,7 @@ class ChipEvaluatorPool(Logger):
         ema = self.genome_duration_ema
         self.genome_duration_ema = dt if ema is None \
             else 0.7 * ema + 0.3 * dt
-        telemetry.histogram("ga.genome_seconds").record(dt)
+        telemetry.histogram(events.HIST_GA_GENOME_SECONDS).record(dt)
 
     def evaluate_many(self, values_list: List[Dict[str, Any]]) \
             -> List[float]:
@@ -395,8 +397,9 @@ class ChipEvaluatorPool(Logger):
                 # now the gene is the prime suspect: score it inf
                 pending.pop(0)
                 fits[head["id"]] = float("inf")
-                telemetry.counter("ga.genomes_lost").inc()
-                telemetry.event("ga.genome_lost", job=head["id"])
+                telemetry.counter(events.CTR_GA_GENOMES_LOST).inc()
+                telemetry.event(events.EV_GA_GENOME_LOST,
+                                job=head["id"])
                 self.warning(
                     "evaluator lost genome %s twice (%s); scoring inf,"
                     " restarting for %d remaining", head["id"],
@@ -406,8 +409,9 @@ class ChipEvaluatorPool(Logger):
                 # its own accord — give the innocent-until-proven
                 # genome one retry on the fresh evaluator
                 retried.add(head["id"])
-                telemetry.counter("ga.genome_retries").inc()
-                telemetry.event("ga.genome_retry", job=head["id"])
+                telemetry.counter(events.CTR_GA_GENOME_RETRIES).inc()
+                telemetry.event(events.EV_GA_GENOME_RETRY,
+                                job=head["id"])
                 self.warning(
                     "evaluator lost genome %s in flight; "
                     "retrying it once on a fresh evaluator",

@@ -312,7 +312,7 @@ def serve(args) -> int:
     import os
     import threading
 
-    from veles_tpu import faults, telemetry
+    from veles_tpu import events, faults, telemetry
     from veles_tpu.backends import make_device
     from veles_tpu.config import root
     from veles_tpu.logger import setup_logging
@@ -375,12 +375,13 @@ def serve(args) -> int:
         if "gen" in job:
             fault_ctx["gen"] = job["gen"]
         seq += 1
-        telemetry.counter("evaluator.jobs").inc()
+        telemetry.counter(events.CTR_EVALUATOR_JOBS).inc()
         try:
             # the span is the child-side per-job record: its histogram
             # (evaluator.job_seconds) and journal line ride the
             # snapshot the parent pool merges after this process dies
-            with telemetry.span("evaluator.job_seconds", journal=True,
+            with telemetry.span(events.SPAN_EVALUATOR_JOB_SECONDS,
+                                journal=True,
                                 job=job["id"],
                                 cohort=len(job.get("members", []))
                                 or None):
@@ -412,7 +413,7 @@ def serve(args) -> int:
         except BaseException as e:  # noqa: BLE001 — bad genes score
             # inf at the parent; the evaluator must outlive them
             result["error"] = f"{type(e).__name__}: {e}"
-            telemetry.counter("evaluator.job_errors").inc()
+            telemetry.counter(events.CTR_EVALUATOR_JOB_ERRORS).inc()
         hb_state["job"] = None
         # flush BEFORE the result line: once the parent sees the
         # result it may kill/merge at any time, and the snapshot must

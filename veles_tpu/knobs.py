@@ -1,0 +1,218 @@
+"""The central registry of ``VELES_*`` environment knobs.
+
+Every environment variable this framework reads is declared HERE —
+name, default, parser, and a one-line doc — and nowhere else.  The
+declarations serve three consumers:
+
+- **veleslint's env-registry rule** (veles_tpu/analysis): any
+  ``os.environ`` read of a ``VELES_*`` name that is not declared here
+  is a lint finding, so a typo'd knob (read forever, set never) can't
+  ship;
+- **docs/guide.md**: the knob table in the guide is GENERATED from
+  this module (``python scripts/veleslint.py --sync-docs``) and the
+  same lint rule fails when the table drifts out of sync;
+- **call sites**, which may read through ``get(name)`` for parsed
+  values but are equally free to keep their existing
+  ``os.environ.get(...)`` reads — declaration, not routing, is the
+  contract.
+
+Parsers: ``flag`` knobs are armed by any non-empty value except
+``"0"`` (matching the scattered ``== "1"`` / truthiness idioms the
+call sites actually use); the rest parse with the declared type and
+fall back to the default on a malformed value rather than raising —
+an env typo must degrade, not take down a run.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+
+def flag(raw: str) -> bool:
+    """The repo's env-flag convention: set-and-not-"0" means on."""
+    return bool(raw) and raw != "0"
+
+
+class Knob:
+    """One declared environment knob."""
+
+    __slots__ = ("name", "default", "parser", "doc")
+
+    def __init__(self, name: str, default: Any,
+                 parser: Callable[[str], Any], doc: str) -> None:
+        self.name = name
+        self.default = default
+        self.parser = parser
+        self.doc = doc
+
+    @property
+    def type_name(self) -> str:
+        return self.parser.__name__
+
+    def read(self, environ: Optional[Dict[str, str]] = None) -> Any:
+        env = os.environ if environ is None else environ
+        raw = env.get(self.name)
+        if raw is None or raw == "":
+            return self.default
+        try:
+            return self.parser(raw)
+        except (TypeError, ValueError):
+            return self.default
+
+    def __repr__(self) -> str:
+        return f"Knob({self.name}={self.default!r})"
+
+
+#: every declared knob, by name — the single source of truth
+KNOBS: Dict[str, Knob] = {}
+
+
+def _knob(name: str, default: Any, parser: Callable[[str], Any],
+          doc: str) -> str:
+    assert name.startswith("VELES_"), name
+    assert name not in KNOBS, f"duplicate knob {name}"
+    KNOBS[name] = Knob(name, default, parser, doc)
+    return name
+
+
+# -- robustness / supervision (Faultline, Phoenix) ---------------------
+
+FAULTS = _knob(
+    "VELES_FAULTS", "", str,
+    "Arm Faultline injection points: `point[@qual=v[&qual=v...]]`, "
+    "comma-separated; inherited by child processes (faults.py).")
+FAULTS_SEED = _knob(
+    "VELES_FAULTS_SEED", 0, int,
+    "Seed for the deterministic garbage/rng of injected faults.")
+PREEMPT_GRACE = _knob(
+    "VELES_PREEMPT_GRACE", 25.0, float,
+    "Seconds a graceful stop may take before the watchdog "
+    "hard-snapshots and exits 14.")
+PREEMPT_DISABLE = _knob(
+    "VELES_PREEMPT_DISABLE", False, flag,
+    "Opt this process out of SIGTERM/SIGINT graceful-stop handlers "
+    "(set for GA evaluator children).")
+SUPERVISE_ATTEMPT = _knob(
+    "VELES_SUPERVISE_ATTEMPT", 0, int,
+    "Exported by the supervisor to each child: 0 first launch, "
+    "incrementing per restart (fault qualifiers target one attempt).")
+SUPERVISE_MAX_CRASHES = _knob(
+    "VELES_SUPERVISE_MAX_CRASHES", 5, int,
+    "Genuine crashes inside the crash window before --supervise "
+    "gives up loudly.")
+SUPERVISE_CRASH_WINDOW = _knob(
+    "VELES_SUPERVISE_CRASH_WINDOW", 300.0, float,
+    "Seconds of sliding window the supervisor counts crashes in.")
+RESUME_MANIFEST = _knob(
+    "VELES_RESUME_MANIFEST", "", str,
+    "Extra path every snapshot/checkpoint writer merge-updates the "
+    "resume manifest at (the supervisor exports it).")
+
+# -- multihost ---------------------------------------------------------
+
+MULTIHOST_HEARTBEAT = _knob(
+    "VELES_MULTIHOST_HEARTBEAT", 2.0, float,
+    "Seconds between KV-store liveness heartbeats of a --multihost "
+    "peer.")
+MULTIHOST_DEADLINE = _knob(
+    "VELES_MULTIHOST_DEADLINE", 15.0, float,
+    "Seconds without a peer heartbeat before the watchdog declares "
+    "peer death (final snapshot + exit 13).")
+MULTIHOST_ALLOW_SOLO = _knob(
+    "VELES_MULTIHOST_ALLOW_SOLO", False, flag,
+    "Accept single-process semantics when "
+    "jax.distributed.initialize() refuses a --multihost launch.")
+
+# -- genetic search ----------------------------------------------------
+
+GA_GENERATION = _knob(
+    "VELES_GA_GENERATION", 0, int,
+    "Exported by the GA parent so evaluator jobs and fault "
+    "qualifiers (`@gen=N`) can target one generation.")
+HEARTBEAT_EVERY = _knob(
+    "VELES_HEARTBEAT_EVERY", 5.0, float,
+    "Seconds between serve-mode evaluator heartbeat lines "
+    "(0 disables).")
+TPU_GA_HBM_BUDGET = _knob(
+    "VELES_TPU_GA_HBM_BUDGET", 8 << 30, int,
+    "HBM byte budget for population-batched cohort sizing when the "
+    "device reports no bytes_limit.")
+
+# -- observability -----------------------------------------------------
+
+METRICS_DIR = _knob(
+    "VELES_METRICS_DIR", "", str,
+    "Arm Sightline persistence: journal-<pid>.jsonl + atomic "
+    "metrics-<pid>.json snapshots under this directory; inherited by "
+    "children.")
+PLOTS_DIR = _knob(
+    "VELES_PLOTS_DIR", "plots", str,
+    "Output directory of the graphics server's rendered plot "
+    "artifacts.")
+
+# -- device / kernel tuning --------------------------------------------
+
+MAX_RESIDENT_BYTES = _knob(
+    "VELES_MAX_RESIDENT_BYTES", 8 << 30, int,
+    "HBM byte budget for device-resident datasets; over budget "
+    "degrades to host streaming.")
+TPU_SCAN_UNROLL = _knob(
+    "VELES_TPU_SCAN_UNROLL", 1, int,
+    "Unroll factor of the fused train loop's lax.scan (>1 trades "
+    "compile time for scheduling overlap).")
+TPU_CONV_S2D = _knob(
+    "VELES_TPU_CONV_S2D", False, flag,
+    "Use the space-to-depth conv formulation for stride-matched "
+    "first layers.")
+TPU_LRN_PALLAS = _knob(
+    "VELES_TPU_LRN_PALLAS", False, flag,
+    "Route LRN through the hand-written pallas kernel instead of the "
+    "XLA lowering.")
+TPU_LRN_RECOMPUTE = _knob(
+    "VELES_TPU_LRN_RECOMPUTE", False, flag,
+    "Recompute LRN normalizers in the backward pass instead of "
+    "saving them (HBM for FLOPs).")
+TPU_SYNTH_CACHE = _knob(
+    "VELES_TPU_SYNTH_CACHE", False, flag,
+    "Cache large synthetic datasets in-process across loader "
+    "constructions (bench/ablation runs).")
+
+# -- XLA compile cache -------------------------------------------------
+
+TPU_NO_COMPILE_CACHE = _knob(
+    "VELES_TPU_NO_COMPILE_CACHE", False, flag,
+    "Disable the persistent XLA compile cache entirely.")
+TPU_COMPILE_CACHE_DIR = _knob(
+    "VELES_TPU_COMPILE_CACHE_DIR", "", str,
+    "Override the era-namespaced default directory of the persistent "
+    "XLA compile cache.")
+
+
+def names() -> frozenset:
+    """Every declared knob name (the env-registry rule's whitelist)."""
+    return frozenset(KNOBS)
+
+
+def get(name: str, environ: Optional[Dict[str, str]] = None) -> Any:
+    """The parsed value of a declared knob (default when unset or
+    malformed).  Raises KeyError on an undeclared name — reading an
+    unregistered knob is exactly the bug the registry exists to
+    catch."""
+    return KNOBS[name].read(environ)
+
+
+def render_table() -> str:
+    """The guide's knob table, generated (markdown, sorted by name).
+    ``scripts/veleslint.py --sync-docs`` writes it between the
+    ``veleslint:knobs`` markers in docs/guide.md and the env-registry
+    rule fails when the checked-in copy drifts."""
+    rows = ["| Knob | Default | Type | Meaning |",
+            "| --- | --- | --- | --- |"]
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        default = "off" if k.parser is flag else \
+            ("(unset)" if k.default == "" else repr(k.default))
+        rows.append(f"| `{name}` | {default} | {k.type_name} | "
+                    f"{k.doc} |")
+    return "\n".join(rows) + "\n"

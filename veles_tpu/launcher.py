@@ -22,7 +22,7 @@ import threading
 import time
 from typing import Any, Optional
 
-from veles_tpu import faults, prng, telemetry
+from veles_tpu import events, faults, prng, telemetry
 from veles_tpu.backends import Device, make_device
 from veles_tpu.config import root
 from veles_tpu.logger import Logger, setup_logging
@@ -257,8 +257,8 @@ class Launcher(Logger):
     def _preempt_watchdog(self, publish: bool) -> None:
         grace = self.preempt_grace()
         name = self._preempt_signal_name()
-        telemetry.event("preempt.requested", signal=name, grace=grace,
-                        multihost=self.multihost)
+        telemetry.event(events.EV_PREEMPT_REQUESTED, signal=name,
+                        grace=grace, multihost=self.multihost)
         self.warning(
             "preemption requested (%s): stopping at the next dispatch "
             "boundary; final snapshot due within %.0fs "
@@ -280,7 +280,7 @@ class Launcher(Logger):
         # preemption must never outlive the platform's kill deadline
         self.error("graceful stop missed the %.0fs grace deadline — "
                    "hard final snapshot from the watchdog", grace)
-        telemetry.event("preempt.deadline_exceeded", grace=grace)
+        telemetry.event(events.EV_PREEMPT_DEADLINE_EXCEEDED, grace=grace)
         result: dict = {}
 
         def snap() -> None:
@@ -306,7 +306,8 @@ class Launcher(Logger):
         t0 = time.perf_counter()
         path = self.final_snapshot(f"preempt-{name}")
         dt = time.perf_counter() - t0
-        telemetry.gauge("preempt.snapshot_seconds").set(round(dt, 3))
+        telemetry.gauge(events.GAUGE_PREEMPT_SNAPSHOT_SECONDS).set(
+            round(dt, 3))
         self._preempt_done.set()
         self.warning(
             "preempted (%s): final snapshot %s (%.2fs); exiting %d",
@@ -356,13 +357,14 @@ class Launcher(Logger):
             out = save_workflow(self.workflow, path)
             dt = round(time.perf_counter() - t0, 3)
             if reason.startswith("multihost"):
-                telemetry.counter("multihost.emergency_snapshots").inc()
-                telemetry.event("multihost.emergency_snapshot",
+                telemetry.counter(
+                    events.CTR_MULTIHOST_EMERGENCY_SNAPSHOTS).inc()
+                telemetry.event(events.EV_MULTIHOST_EMERGENCY_SNAPSHOT,
                                 path=out, seconds=dt)
             else:
-                telemetry.counter("preempt.final_snapshots").inc()
-                telemetry.event("preempt.final_snapshot", path=out,
-                                reason=reason, seconds=dt)
+                telemetry.counter(events.CTR_PREEMPT_FINAL_SNAPSHOTS).inc()
+                telemetry.event(events.EV_PREEMPT_FINAL_SNAPSHOT,
+                                path=out, reason=reason, seconds=dt)
             write_resume_manifest(snapshot=out, reason=reason)
             telemetry.flush()   # os._exit follows — atexit never runs
             return out
@@ -381,7 +383,7 @@ class Launcher(Logger):
         workflow state and exit with a distinctive code — the
         supervisor's restart-from-snapshot path, not a hang and not a
         lost run."""
-        telemetry.event("multihost.collective_failed",
+        telemetry.event(events.EV_MULTIHOST_COLLECTIVE_FAILED,
                         error=f"{type(exc).__name__}: {exc}")
         path = self._emergency_snapshot()
         # flush UNCONDITIONALLY: when the snapshot failed, the flush
@@ -465,8 +467,8 @@ class Launcher(Logger):
                     # the freshest peer-liveness age the run observed
                     # — obs_report's first read on a wedged slice
                     telemetry.gauge(
-                        "multihost.peer_heartbeat_age").set(
-                        round(now - last, 3))
+                        events.GAUGE_MULTIHOST_PEER_HEARTBEAT_AGE
+                    ).set(round(now - last, 3))
                     last = now
                     seq += 1
                     continue
@@ -504,7 +506,7 @@ class Launcher(Logger):
                     return
                 if self._preempt_signum is None:
                     self._preempt_signum = int(_signal.SIGTERM)
-                    telemetry.event("preempt.peer_broadcast")
+                    telemetry.event(events.EV_PREEMPT_PEER_BROADCAST)
                     self.warning("peer broadcast veles_preempt — "
                                  "joining the coordinated graceful "
                                  "stop")
@@ -537,7 +539,7 @@ class Launcher(Logger):
         from here with a bounded grace period, then the process exits
         with the clean abort code (never hangs, never waits for the
         coordination service's SIGABRT)."""
-        telemetry.event("multihost.peer_death", peer=peer,
+        telemetry.event(events.EV_MULTIHOST_PEER_DEATH, peer=peer,
                         deadline=deadline)
         self.error(
             "multihost peer %d missed its liveness deadline (%.1fs) — "
@@ -576,13 +578,12 @@ class Launcher(Logger):
         forwards = getattr(self.workflow, "forwards", None)
         if not forwards:
             return
-        import json
         from veles_tpu import profiling
+        from veles_tpu.snapshotter import write_json_atomic
         path = os.path.join(self.profile_dir, "flops_table.json")
-        with open(path, "w") as f:
-            json.dump({"layers": profiling.layer_flops_table(forwards),
-                       "total": profiling.model_flops_per_sample(
-                           forwards)}, f, indent=2)
+        write_json_atomic(path, {
+            "layers": profiling.layer_flops_table(forwards),
+            "total": profiling.model_flops_per_sample(forwards)})
         self.info("profile: trace + flops_table.json in %s",
                   self.profile_dir)
 
@@ -645,7 +646,7 @@ def init_multihost() -> None:
             # single-process would train on 1/N of the data and
             # checkpoint a state no peer can join — fail LOUDLY unless
             # the operator explicitly accepts solo semantics.
-            telemetry.event("multihost.init_refused", error=str(e))
+            telemetry.event(events.EV_MULTIHOST_INIT_REFUSED, error=str(e))
             if os.environ.get("VELES_MULTIHOST_ALLOW_SOLO") == "1":
                 import logging
                 logging.getLogger("veles_tpu.launcher").warning(

@@ -25,7 +25,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
-from veles_tpu import prng, telemetry
+from veles_tpu import events, prng, telemetry
 from veles_tpu.distributable import Distributable
 from veles_tpu.memory import Vector
 from veles_tpu.mutable import Bool
@@ -251,9 +251,9 @@ class Loader(Unit, Distributable):
                 if self._epoch_t0 is not None:
                     dt = time.monotonic() - self._epoch_t0
                     telemetry.histogram(
-                        "loader.epoch_seconds").record(dt)
-                    telemetry.counter("loader.epochs").inc()
-                    telemetry.event("loader.epoch",
+                        events.HIST_LOADER_EPOCH_SECONDS).record(dt)
+                    telemetry.counter(events.CTR_LOADER_EPOCHS).inc()
+                    telemetry.event(events.EV_LOADER_EPOCH,
                                     epoch=self.epoch_number,
                                     seconds=round(dt, 3))
                 self._epoch_t0 = time.monotonic()

@@ -201,9 +201,9 @@ class FullBatchLoader(Loader):
                 # fits but the device disagreed (fragmentation, other
                 # tenants) — stream superstep batches from host
                 # instead of dying at initialize
-                from veles_tpu import telemetry
-                telemetry.counter("device.oom_degraded").inc()
-                telemetry.event("device.oom_degraded",
+                from veles_tpu import events, telemetry
+                telemetry.counter(events.CTR_DEVICE_OOM_DEGRADED).inc()
+                telemetry.event(events.EV_DEVICE_OOM_DEGRADED,
                                 site="resident_dataset")
                 self.warning(
                     "dataset upload hit device OOM (%s) — falling "

@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from veles_tpu import telemetry
+from veles_tpu import events, telemetry
 from veles_tpu.loader.base import TEST, TRAIN, VALID
 from veles_tpu.loader.fullbatch import FullBatchLoader
 
@@ -176,12 +176,12 @@ class FileListImageLoader(FullBatchLoader):
         n_bad, n_all = len(self.corrupt_indices), max(len(self._paths),
                                                       1)
         if new:
-            telemetry.counter("loader.corrupt_skipped").inc()
+            telemetry.counter(events.CTR_LOADER_CORRUPT_SKIPPED).inc()
         if new and n_bad <= 5:
             # the journal gate matches the warn gate: a dying disk
             # must not flood the event stream (the counter keeps the
             # full tally)
-            telemetry.event("loader.corrupt_file",
+            telemetry.event(events.EV_LOADER_CORRUPT_FILE,
                             path=self._paths[i], index=int(i))
             self.warning(
                 "corrupt image skipped (%d bad of %d): %s (%s: %s)%s",
@@ -191,7 +191,7 @@ class FileListImageLoader(FullBatchLoader):
         allowed = max(1, int(self.corrupt_tolerance * n_all)) \
             if self.corrupt_tolerance > 0 else 0
         if n_bad > allowed:
-            telemetry.event("loader.corrupt_over_tolerance",
+            telemetry.event(events.EV_LOADER_CORRUPT_OVER_TOLERANCE,
                             bad=n_bad, total=n_all)
             raise RuntimeError(
                 f"{self.name}: {n_bad}/{n_all} files failed to decode "
@@ -221,9 +221,9 @@ class FileListImageLoader(FullBatchLoader):
             out = np.stack(list(self._decode_pool.map(
                 self._decode_one, indices)))
         if telemetry.enabled():
-            telemetry.histogram("loader.decode_seconds").record(
+            telemetry.histogram(events.HIST_LOADER_DECODE_SECONDS).record(
                 time.perf_counter() - t0)
-            telemetry.counter("loader.images_decoded").inc(
+            telemetry.counter(events.CTR_LOADER_IMAGES_DECODED).inc(
                 len(indices))
         return out
 

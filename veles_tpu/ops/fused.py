@@ -34,7 +34,7 @@ import numpy as np
 
 from veles_tpu.accelerated_units import AcceleratedUnit
 from veles_tpu.loader.base import TRAIN
-from veles_tpu import prng, telemetry
+from veles_tpu import events, prng, telemetry
 
 
 class FusedStepRunner(AcceleratedUnit):
@@ -511,15 +511,15 @@ class FusedStepRunner(AcceleratedUnit):
             self._dispatch_seen.add(kind)
             telemetry.gauge(
                 f"fused.first_{kind}_dispatch_seconds").set(dt)
-            telemetry.event("fused.first_dispatch", kind=kind,
+            telemetry.event(events.EV_FUSED_FIRST_DISPATCH, kind=kind,
                             seconds=round(dt, 4),
                             streaming=bool(self.streaming))
         else:
             telemetry.histogram(
                 f"fused.{kind}_dispatch_seconds").record(dt)
-        telemetry.counter("fused.dispatches").inc()
+        telemetry.counter(events.CTR_FUSED_DISPATCHES).inc()
         telemetry.counter(f"fused.{kind}_seconds").inc(dt)
-        telemetry.counter("fused.minibatches").inc(k)
+        telemetry.counter(events.CTR_FUSED_MINIBATCHES).inc(k)
         telemetry.counter(f"fused.{kind}_images").inc(images)
 
     def _run_resident(self, ld, k, indices, mask, train: bool) -> None:
@@ -574,7 +574,8 @@ class FusedStepRunner(AcceleratedUnit):
         # tests divide this by processed images
         n_wire = int(xb.nbytes) + int(tb.nbytes)
         self._stream_bytes += n_wire
-        telemetry.counter("fused.stream_transfer_bytes").inc(n_wire)
+        telemetry.counter(
+            events.CTR_FUSED_STREAM_TRANSFER_BYTES).inc(n_wire)
         t_transfer = time.perf_counter()
         for attempt in (1, 2):
             try:
@@ -599,8 +600,9 @@ class FusedStepRunner(AcceleratedUnit):
                     "streaming upload hit device OOM (%s); draining "
                     "the in-flight double-buffer and retrying once", e)
                 self.stream_oom_retries += 1
-                telemetry.counter("fused.stream_oom_retries").inc()
-                telemetry.event("device.oom_retry", site="stream")
+                telemetry.counter(
+                    events.CTR_FUSED_STREAM_OOM_RETRIES).inc()
+                telemetry.event(events.EV_DEVICE_OOM_RETRY, site="stream")
                 while self._inflight:
                     for buf in self._inflight.popleft():
                         buf.block_until_ready()
@@ -613,7 +615,8 @@ class FusedStepRunner(AcceleratedUnit):
                 buf.block_until_ready()
         dt_transfer = time.perf_counter() - t_transfer
         self.stream_transfer_seconds += dt_transfer
-        telemetry.counter("fused.stream_transfer_seconds").inc(
+        telemetry.counter(
+            events.CTR_FUSED_STREAM_TRANSFER_SECONDS).inc(
             dt_transfer)
         if train:
             self._params, self._opt, self._acc, self._conf = \
@@ -685,21 +688,23 @@ class FusedStepRunner(AcceleratedUnit):
         if elapsed <= 0 or images <= 0:
             return
         rate = images / elapsed
-        telemetry.gauge("fused.train_images_per_sec_wall").set(
+        telemetry.gauge(
+            events.GAUGE_FUSED_TRAIN_IMAGES_PER_SEC_WALL).set(
             round(rate, 3))
         try:
             from veles_tpu import profiling
             flops = profiling.model_flops_per_sample(
                 self.forwards)["train"]
-            telemetry.gauge("fused.train_gflops_per_image").set(
+            telemetry.gauge(
+                events.GAUGE_FUSED_TRAIN_GFLOPS_PER_IMAGE).set(
                 round(flops / 1e9, 4))
             jdev = getattr(self.device, "jax_device", None)
             u = profiling.mfu(rate, flops, jdev) \
                 if jdev is not None else None
             if u is not None:
-                telemetry.gauge("fused.mfu").set(round(u, 5))
+                telemetry.gauge(events.GAUGE_FUSED_MFU).set(round(u, 5))
             telemetry.event(
-                "fused.summary", images=images,
+                events.EV_FUSED_SUMMARY, images=images,
                 images_per_sec_wall=round(rate, 2),
                 mfu=round(u, 5) if u is not None else None,
                 streaming=bool(self.streaming))
@@ -954,11 +959,12 @@ class EnsembleEvalEngine:
         upload + the full vmapped member sweep."""
         if not telemetry.enabled():
             return
-        telemetry.histogram("ensemble.dispatch_seconds").record(dt)
-        telemetry.counter("ensemble.chunks").inc()
-        telemetry.counter("ensemble.seconds").inc(dt)
-        telemetry.counter("ensemble.images").inc(images)
-        telemetry.counter("ensemble.member_images").inc(
+        telemetry.histogram(
+            events.HIST_ENSEMBLE_DISPATCH_SECONDS).record(dt)
+        telemetry.counter(events.CTR_ENSEMBLE_CHUNKS).inc()
+        telemetry.counter(events.CTR_ENSEMBLE_SECONDS).inc(dt)
+        telemetry.counter(events.CTR_ENSEMBLE_IMAGES).inc(images)
+        telemetry.counter(events.CTR_ENSEMBLE_MEMBER_IMAGES).inc(
             images * self.n_members)
 
     def error_pct(self, x: np.ndarray, labels: np.ndarray,
@@ -991,11 +997,12 @@ class EnsembleEvalEngine:
         pass-level wall is honest)."""
         if not telemetry.enabled():
             return
-        telemetry.histogram("ensemble.score_seconds").record(dt)
-        telemetry.counter("ensemble.chunks").inc(chunks)
-        telemetry.counter("ensemble.seconds").inc(dt)
-        telemetry.counter("ensemble.images").inc(images)
-        telemetry.counter("ensemble.member_images").inc(
+        telemetry.histogram(
+            events.HIST_ENSEMBLE_SCORE_SECONDS).record(dt)
+        telemetry.counter(events.CTR_ENSEMBLE_CHUNKS).inc(chunks)
+        telemetry.counter(events.CTR_ENSEMBLE_SECONDS).inc(dt)
+        telemetry.counter(events.CTR_ENSEMBLE_IMAGES).inc(images)
+        telemetry.counter(events.CTR_ENSEMBLE_MEMBER_IMAGES).inc(
             images * self.n_members)
 
     # -- resident path -------------------------------------------------
@@ -1355,10 +1362,11 @@ class PopulationTrainEngine:
         from veles_tpu import faults
         from veles_tpu.loader.base import TRAIN, VALID
 
-        with telemetry.span("ga.cohort_train", journal=True,
+        with telemetry.span(events.SPAN_GA_COHORT_TRAIN, journal=True,
                             members=self.n_members):
-            telemetry.counter("ga.cohorts").inc()
-            telemetry.counter("ga.cohort_members").inc(self.n_members)
+            telemetry.counter(events.CTR_GA_COHORTS).inc()
+            telemetry.counter(
+                events.CTR_GA_COHORT_MEMBERS).inc(self.n_members)
             return self._run_inner(faults, TRAIN, VALID)
 
     def _run_inner(self, faults, TRAIN, VALID) -> np.ndarray:

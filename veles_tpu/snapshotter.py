@@ -35,6 +35,7 @@ candidates without importing the model classes they pickle).
 from __future__ import annotations
 
 import bz2
+import contextlib
 import gzip
 import json
 import lzma
@@ -46,7 +47,7 @@ import time
 import zlib
 from typing import Any, List, Optional
 
-from veles_tpu import faults, prng, telemetry
+from veles_tpu import events, faults, prng, telemetry
 from veles_tpu.units import Unit
 
 _OPENERS = {".gz": gzip.open, ".bz2": bz2.open, ".xz": lzma.open,
@@ -104,9 +105,10 @@ def save_workflow(workflow, path: str) -> str:
             pass
         raise
     dt = time.perf_counter() - t0
-    telemetry.histogram("snapshot.save_seconds").record(dt)
-    telemetry.counter("snapshot.saves").inc()
-    telemetry.event("snapshot.save", path=os.path.basename(path),
+    telemetry.histogram(events.HIST_SNAPSHOT_SAVE_SECONDS).record(dt)
+    telemetry.counter(events.CTR_SNAPSHOT_SAVES).inc()
+    telemetry.event(events.EV_SNAPSHOT_SAVE,
+                    path=os.path.basename(path),
                     bytes=len(blob), seconds=round(dt, 3))
     return path
 
@@ -213,15 +215,21 @@ MANIFEST_ENV = "VELES_RESUME_MANIFEST"
 MANIFEST_NAME = "resume_manifest.json"
 
 
-def _write_json_atomic(path: str, payload: dict) -> None:
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "wb"):
+    """Open a pid-unique temp file next to ``path`` and atomically
+    ``os.replace`` it over ``path`` on clean exit (removed on error) —
+    THE way any persistent file is written in this codebase, and what
+    veleslint's atomic-write rule points a bare ``open(path, "w")``
+    at.  A reader (or a concurrent writer) never sees a torn file."""
     directory = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(directory, exist_ok=True)
     fd, tmp = tempfile.mkstemp(
         dir=directory, prefix=os.path.basename(path) + ".",
         suffix=".tmp")
     try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(payload, f, indent=1)
+        with os.fdopen(fd, mode) as f:
+            yield f
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -229,6 +237,15 @@ def _write_json_atomic(path: str, payload: dict) -> None:
         except OSError:
             pass
         raise
+
+
+def write_json_atomic(path: str, payload: dict) -> None:
+    with atomic_write(path, "w") as f:
+        json.dump(payload, f, indent=1)
+
+
+#: PR-6/7 internal name, kept for existing callers/tests
+_write_json_atomic = write_json_atomic
 
 
 def read_resume_manifest(path: str) -> Optional[dict]:
@@ -306,16 +323,17 @@ def load_workflow(path: str, fallback: bool = False):
                 log.warning("predecessor %s also corrupt (%s)",
                             cand, e2)
                 continue
-            telemetry.counter("snapshot.fallbacks").inc()
-            telemetry.event("snapshot.fallback", corrupt=path,
+            telemetry.counter(events.CTR_SNAPSHOT_FALLBACKS).inc()
+            telemetry.event(events.EV_SNAPSHOT_FALLBACK, corrupt=path,
                             used=cand)
             log.warning("resuming from intact predecessor %s "
                         "instead of corrupt %s", cand, path)
             break
         if payload is None:
-            telemetry.event("snapshot.unrecoverable", path=path)
+            telemetry.event(events.EV_SNAPSHOT_UNRECOVERABLE,
+                            path=path)
             raise
-    telemetry.histogram("snapshot.load_seconds").record(
+    telemetry.histogram(events.HIST_SNAPSHOT_LOAD_SECONDS).record(
         time.perf_counter() - t0)
     prng.restore_state(payload["prng"])
     return payload["workflow"]

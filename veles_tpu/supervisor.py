@@ -59,7 +59,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu import telemetry
+from veles_tpu import events, telemetry
 from veles_tpu.logger import Logger
 
 #: the exit-code contract (kept equal to Launcher's constants; the
@@ -208,7 +208,7 @@ class Supervisor(Logger):
                     argv += ["--snapshot", snap]
         elif manifest.get("ga_state"):
             source, state = "ga_state", manifest["ga_state"]
-        telemetry.event("supervisor.resumed", attempt=attempt,
+        telemetry.event(events.EV_SUPERVISOR_RESUMED, attempt=attempt,
                         source=source, state=state,
                         downtime=None if downtime is None
                         else round(downtime, 3))
@@ -267,7 +267,8 @@ class Supervisor(Logger):
                     else now - last_death
                 if downtime is not None:
                     telemetry.histogram(
-                        "supervisor.downtime_seconds").record(downtime)
+                        events.HIST_SUPERVISOR_DOWNTIME_SECONDS
+                    ).record(downtime)
                 argv = self._argv_for_attempt(attempt, downtime)
                 env = dict(os.environ)
                 env[MANIFEST_ENV] = self.manifest_path
@@ -280,10 +281,10 @@ class Supervisor(Logger):
                 if self._shutdown_sig is not None:
                     self.warning("supervisor was signaled — not "
                                  "resuming; child exited %d", code)
-                    telemetry.event("supervisor.shutdown", rc=code)
+                    telemetry.event(events.EV_SUPERVISOR_SHUTDOWN, rc=code)
                     return code
                 if code == EXIT_DONE:
-                    telemetry.event("supervisor.done",
+                    telemetry.event(events.EV_SUPERVISOR_DONE,
                                     attempts=attempt + 1)
                     self.info("run complete after %d attempt(s), "
                               "%d restart(s)", attempt + 1,
@@ -299,7 +300,7 @@ class Supervisor(Logger):
                 if code == EXIT_USAGE:
                     # argparse/config errors are deterministic: a
                     # restart loop would fail identically forever
-                    telemetry.event("supervisor.giveup", rc=code,
+                    telemetry.event(events.EV_SUPERVISOR_GIVEUP, rc=code,
                                     reason="usage_error")
                     self.error("child failed with a usage error (2); "
                                "giving up")
@@ -311,7 +312,7 @@ class Supervisor(Logger):
                     crash_times.popleft()
                 if len(crash_times) >= self.max_crashes:
                     telemetry.event(
-                        "supervisor.giveup", rc=code,
+                        events.EV_SUPERVISOR_GIVEUP, rc=code,
                         crashes=len(crash_times),
                         window=self.crash_window)
                     telemetry.flush()
@@ -345,8 +346,9 @@ class Supervisor(Logger):
     def _note_restart(self, code: int, attempt: int, kind: str,
                       delay: float) -> None:
         self.restarts += 1
-        telemetry.counter("supervisor.restarts").inc()
-        telemetry.event("supervisor.restart", rc=code, attempt=attempt,
+        telemetry.counter(events.CTR_SUPERVISOR_RESTARTS).inc()
+        telemetry.event(events.EV_SUPERVISOR_RESTART, rc=code,
+                        attempt=attempt,
                         kind=kind, budget_charged=(kind == "crash"),
                         delay=round(delay, 3))
         self.warning("child exited %d (%s) — restarting (attempt %d"
@@ -388,15 +390,15 @@ def install_ga_stop(grace: Optional[float] = None,
         grace = float(os.environ.get("VELES_PREEMPT_GRACE", "25"))
 
     def watchdog(name: str) -> None:
-        telemetry.event("preempt.requested", signal=name, grace=grace,
-                        mode="ga")
+        telemetry.event(events.EV_PREEMPT_REQUESTED, signal=name,
+                        grace=grace, mode="ga")
         log.warning(
             "preemption requested (%s): stopping at the next GA "
             "generation boundary (checkpoint = resume point); hard "
             "exit in %.0fs", name, grace)
         if done.wait(grace):
             return
-        telemetry.event("preempt.deadline_exceeded", grace=grace,
+        telemetry.event(events.EV_PREEMPT_DEADLINE_EXCEEDED, grace=grace,
                         mode="ga")
         log.error("GA graceful stop missed the %.0fs grace deadline "
                   "— exiting %d on the last checkpoint", grace,
@@ -432,7 +434,7 @@ def install_ga_stop(grace: Optional[float] = None,
                 pass
         if state["sig"] is None:
             return None
-        telemetry.event("preempt.ga_exit", code=EXIT_PREEMPTED)
+        telemetry.event(events.EV_PREEMPT_GA_EXIT, code=EXIT_PREEMPTED)
         telemetry.flush()
         log.warning("GA preempted: exiting %d (resume via the same "
                     "--ga-state / --supervise invocation)",
