@@ -641,6 +641,229 @@ def ga_metric(phase):
         return None
 
 
+def _serve_hist_window(after, before):
+    """Reconstruct the latency distribution of ONE measurement window
+    from two cumulative histogram snapshots (bucket-wise subtraction;
+    min/max approximated by the cumulative ones, which only widens the
+    clamp range the quantile interpolation uses)."""
+    from veles_tpu.telemetry import Histogram
+    a, b = dict(after or {}), dict(before or {})
+    h = Histogram("window")
+    h.count = int(a.get("count", 0)) - int(b.get("count", 0))
+    h.sum = float(a.get("sum", 0.0)) - float(b.get("sum", 0.0))
+    if a.get("min") is not None:
+        h.min = float(a["min"])
+    if a.get("max") is not None:
+        h.max = float(a["max"])
+    bb = b.get("buckets") or {}
+    for i, c in (a.get("buckets") or {}).items():
+        d = int(c) - int(bb.get(i, 0))
+        if d > 0:
+            h.buckets[int(i)] += d
+    return h
+
+
+def serve_metric(phase):
+    """Hive online serving (ISSUE 10 acceptance): sustained QPS of
+    dynamically micro-batched serving vs a one-request-at-a-time loop
+    over the SAME model and server, at equal correctness (both windows
+    answer through the same fixed-shape dispatch; responses are
+    oracle-checked before timing).  The serial loop pays one padded
+    max_batch dispatch per ROW; the batched window pays it per
+    coalesced micro-batch — the speedup is the measured batch fill.
+    p50/p99 come from the server-side ``serve.request_seconds``
+    histogram DELTA across the sustained window, and the compile
+    counter delta across that window must be ZERO (warm steady state
+    never recompiles)."""
+    if os.environ.get("BENCH_SKIP_SERVE"):
+        return None
+    import tempfile
+    import textwrap
+    import threading
+
+    threads = int(os.environ.get("BENCH_SERVE_THREADS", "16"))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "32"))
+    max_wait_ms = float(os.environ.get("BENCH_SERVE_MAX_WAIT_MS", "2"))
+    window = float(os.environ.get("BENCH_SERVE_WINDOW_SEC", "4"))
+    members = int(os.environ.get("BENCH_SERVE_MEMBERS", "4"))
+    hidden = int(os.environ.get("BENCH_SERVE_HIDDEN", "512"))
+    try:
+        from veles_tpu import prng
+        from veles_tpu.backends import NumpyDevice
+        from veles_tpu.ensemble.packaging import pack_ensemble
+        from veles_tpu.launcher import load_workflow_module
+        from veles_tpu.serve.client import HiveClient
+
+        tmp = tempfile.mkdtemp(prefix="bench_serve_")
+        wf = os.path.join(tmp, "wf.py")
+        with open(wf, "w") as f:
+            f.write(textwrap.dedent(f"""
+                from veles_tpu import prng
+                from veles_tpu.datasets import synthetic_classification
+                from veles_tpu.loader import ArrayLoader
+                from veles_tpu.ops.standard_workflow import \\
+                    StandardWorkflow
+
+                def create_workflow(launcher):
+                    prng.seed_all(9191)
+                    train, valid, _ = synthetic_classification(
+                        64, 16, (8, 8, 1), n_classes=10, seed=3)
+                    return StandardWorkflow(
+                        loader_factory=lambda w: ArrayLoader(
+                            w, train=train, valid=valid,
+                            minibatch_size=16, name="loader"),
+                        layers=[
+                            {{"type": "all2all_tanh",
+                              "->": {{"output_sample_shape": {hidden}}},
+                              "<-": {{"learning_rate": 0.1}}}},
+                            {{"type": "softmax",
+                              "->": {{"output_sample_shape": 10}},
+                              "<-": {{"learning_rate": 0.1}}}},
+                        ],
+                        decision_config={{"max_epochs": 1}},
+                        name="serve_bench_wf")
+            """))
+        mod = load_workflow_module(wf)
+
+        class _FL:
+            workflow = None
+
+        def build_members(seed):
+            prng.seed_all(seed)
+            w = mod.create_workflow(_FL())
+            w.initialize(device=NumpyDevice())
+            base = {fw.name: {k: np.asarray(v) for k, v in
+                              fw.gather_params().items()}
+                    for fw in w.forwards}
+            rng = np.random.default_rng(seed)
+            ms = [{"params": {fn: {pn: a + 0.02 * rng
+                                   .standard_normal(a.shape)
+                                   .astype(np.float32)
+                                   for pn, a in p.items()}
+                              for fn, p in base.items()},
+                   "valid_error": 0.0, "seed": seed, "values": None,
+                   "forward_names": [fw.name for fw in w.forwards]}
+                  for _ in range(members)]
+            return w, ms
+
+        phase(f"serve: packing 2 ensemble packages ({members} members "
+              f"x {hidden} hidden)")
+        w_main, members_main = build_members(31)
+        _, members_shadow = build_members(32)
+        pkg_main = os.path.join(tmp, "primary.vpkg")
+        pkg_shadow = os.path.join(tmp, "shadow.vpkg")
+        pack_ensemble(pkg_main, "primary", members_main, wf)
+        pack_ensemble(pkg_shadow, "shadow", members_shadow, wf)
+
+        mdir = os.path.join(tmp, "metrics")
+        phase(f"serve: spawning hive (max_batch={max_batch}, "
+              f"max_wait={max_wait_ms}ms)")
+        client = HiveClient(
+            {"primary": pkg_main, "shadow": pkg_shadow},
+            backend="cpu", max_batch=max_batch,
+            max_wait_ms=max_wait_ms, metrics_dir=mdir,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        try:
+            rng = np.random.default_rng(0)
+            row = rng.standard_normal((1, 8, 8, 1)).astype(np.float32)
+            # correctness gate: the served answer must equal the host
+            # member-loop oracle before any throughput is quoted
+            resp = client.request("primary", row, timeout=120)
+            acc = None
+            for m in members_main:
+                out = row
+                for fw in w_main.forwards:
+                    out, _ = fw.apply_fwd(
+                        {k: np.asarray(v)
+                         for k, v in m["params"][fw.name].items()},
+                        out, rng=None, train=False)
+                out = np.asarray(out)
+                acc = out if acc is None else acc + out
+            want = acc / len(members_main)
+            oracle_diff = float(np.abs(
+                np.asarray(resp["probs"]) - want).max())
+            assert oracle_diff < 1e-4, oracle_diff
+            client.request("shadow", row, timeout=120)   # warm both
+            for _ in range(8):                           # warm steady
+                client.request("primary", row)
+
+            phase("serve: one-request-at-a-time loop (the baseline)")
+            t_end = time.perf_counter() + window
+            n_serial = 0
+            while time.perf_counter() < t_end:
+                client.request("primary", row)
+                n_serial += 1
+            qps_serial = n_serial / window
+
+            st_mid = client.stats()
+            phase(f"serve: serial {qps_serial:.1f} qps; sustained "
+                  f"window ({threads} concurrent clients)")
+            counts = [0] * threads
+            stop_at = time.perf_counter() + window
+
+            def closed_loop(i):
+                r = np.random.default_rng(i)
+                x = r.standard_normal((1, 8, 8, 1)).astype(np.float32)
+                while time.perf_counter() < stop_at:
+                    res = client.request("primary", x, timeout=60)
+                    assert "pred" in res, res
+                    counts[i] += 1
+
+            ts = [threading.Thread(target=closed_loop, args=(i,))
+                  for i in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            qps = sum(counts) / window
+            st_end = client.stats()
+        finally:
+            client.close()
+
+        lat = _serve_hist_window(
+            st_end["histograms"].get("serve.request_seconds"),
+            st_mid["histograms"].get("serve.request_seconds"))
+        batch_hist = st_end["histograms"].get("serve.batch_rows", {})
+        c_end, c_mid = st_end["counters"], st_mid["counters"]
+        rows_w = c_end.get("serve.rows", 0) - c_mid.get("serve.rows",
+                                                        0)
+        slots_w = c_end.get("serve.batch_slots", 0) - \
+            c_mid.get("serve.batch_slots", 0)
+        recompiles = c_end.get("serve.compiles", 0) - \
+            c_mid.get("serve.compiles", 0)
+        out = {
+            "serve_qps_sustained": round(qps, 1),
+            "serve_qps_unbatched": round(qps_serial, 1),
+            "serve_speedup_vs_unbatched": round(
+                qps / max(qps_serial, 1e-9), 2),
+            "serve_p50_ms": round(1000 * (lat.quantile(0.5) or 0), 3),
+            "serve_p99_ms": round(1000 * (lat.quantile(0.99) or 0),
+                                  3),
+            "serve_batch_efficiency": round(rows_w / slots_w, 4)
+            if slots_w else None,
+            "serve_batch_rows_max": batch_hist.get("max"),
+            "serve_models_resident": int(
+                st_end["gauges"].get("serve.models_resident", 0)),
+            "serve_recompiles_post_warmup": int(recompiles),
+            "serve_oracle_max_abs_diff": oracle_diff,
+            "serve_concurrency": threads,
+            "serve_max_batch": max_batch,
+            "serve_max_wait_ms": max_wait_ms,
+            "serve_window_sec": window,
+            "serve_members": members,
+            "serve_platform": "cpu",
+        }
+        phase(f"serve: sustained {qps:.1f} qps vs {qps_serial:.1f} "
+              f"serial ({out['serve_speedup_vs_unbatched']}x), "
+              f"p50 {out['serve_p50_ms']}ms p99 {out['serve_p99_ms']}"
+              f"ms, batch fill {out['serve_batch_efficiency']}, "
+              f"recompiles {recompiles}")
+        return out
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"serve metric failed: {e}", file=sys.stderr)
+        return None
+
+
 def roofline_metric(device, phase):
     """Run ``scripts/layer_roofline.py --measure`` as a recorded phase:
     each AlexNet conv's fwd+bwd timed ALONE on the device against its
@@ -1030,6 +1253,17 @@ def main() -> None:
     # the streaming phase re-derives its base set from the same args —
     # opt into the dataset memo (datasets._synth_cache)
     os.environ.setdefault("VELES_TPU_SYNTH_CACHE", "1")
+    if "--serve-only" in sys.argv:
+        # fast path: run ONLY the Hive serving phase (XLA:CPU, own
+        # subprocess) and print its record — the serving acceptance
+        # gate without the 227x227 headline build
+        t0 = time.perf_counter()
+
+        def _phase(msg):
+            print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}",
+                  file=sys.stderr, flush=True)
+        print(json.dumps(serve_metric(_phase)), flush=True)
+        return
     from veles_tpu import profiling
     from veles_tpu.backends import make_device
 
@@ -1119,6 +1353,22 @@ def main() -> None:
         "ga_genomes_per_sec_batched": None,
         "ga_cohort_speedup": None,
         "ga_fitness_max_abs_diff": None,
+        "serve_qps_sustained": None,
+        "serve_qps_unbatched": None,
+        "serve_speedup_vs_unbatched": None,
+        "serve_p50_ms": None,
+        "serve_p99_ms": None,
+        "serve_batch_efficiency": None,
+        "serve_batch_rows_max": None,
+        "serve_models_resident": None,
+        "serve_recompiles_post_warmup": None,
+        "serve_oracle_max_abs_diff": None,
+        "serve_concurrency": None,
+        "serve_max_batch": None,
+        "serve_max_wait_ms": None,
+        "serve_window_sec": None,
+        "serve_members": None,
+        "serve_platform": None,
         "conv_roofline_minibatch": None,
         "conv_roofline_layers": None,
         "conv_roofline_total_efficiency": None,
@@ -1199,6 +1449,12 @@ def main() -> None:
     ga = ga_metric(phase)
     if ga:
         record.update(ga)
+    emit()
+
+    phase("measuring online serving (Hive, XLA:CPU subprocess)")
+    sv = serve_metric(phase)
+    if sv:
+        record.update(sv)
     emit()
 
     phase("measuring per-conv roofline (layer_roofline --measure)")

@@ -141,3 +141,21 @@ class TestEnsembleCli:
         assert r.returncode == 2
         assert "does not exist" in r.stderr
         assert "Traceback" not in r.stderr
+
+
+class TestServeModelsCli:
+    """The --serve-models entry on the smoke-tested CLI surface (the
+    full subprocess round trip lives in tests/test_serve.py, which
+    drives this same entry through serve.client.HiveClient)."""
+
+    def test_bad_model_spec_is_usage_error(self):
+        r = run_cli(["--serve-models", "not-a-pair"])
+        assert r.returncode == 2
+        assert "NAME=PACKAGE" in r.stderr
+        assert "Traceback" not in r.stderr
+
+    def test_missing_package_is_usage_error(self, tmp_path):
+        r = run_cli(["--serve-models",
+                     f"m={tmp_path}/nope.vpkg"])
+        assert r.returncode == 2
+        assert "no such package" in r.stderr

@@ -1,0 +1,431 @@
+"""Hive serving tier (ISSUE 10 tentpole): dynamic micro-batching,
+multi-model HBM residency with LRU spill, the request-level engine
+API, and the real subprocess round trip over the
+``python -m veles_tpu --serve-models`` CLI surface.
+
+The subprocess tests each spawn ONE server and drive it with
+concurrent client threads, asserting (a) responses match the host
+member-loop oracle, (b) concurrent requests actually coalesced
+(batch-size histogram max > 1), (c) SIGTERM drains in-flight requests
+and exits 14, (d) an over-budget model load spills the LRU model and
+journals the event.
+"""
+
+import json
+import os
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WF_TEXT = textwrap.dedent("""
+    from veles_tpu import prng
+    from veles_tpu.datasets import synthetic_classification
+    from veles_tpu.loader import ArrayLoader
+    from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+    def create_workflow(launcher):
+        prng.seed_all(4242)
+        train, valid, _ = synthetic_classification(
+            64, 16, (6, 6, 1), n_classes=3, seed=5)
+        return StandardWorkflow(
+            loader_factory=lambda w: ArrayLoader(
+                w, train=train, valid=valid, minibatch_size=16,
+                name="loader"),
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 12},
+                 "<-": {"learning_rate": 0.1}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.1}},
+            ],
+            decision_config={"max_epochs": 2}, name="hive_wf")
+""")
+
+
+def _build_package(d, name, seed, n_members=3):
+    """One Forge ensemble package + its host oracle ingredients."""
+    from veles_tpu import prng
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.ensemble.packaging import pack_ensemble
+    from veles_tpu.launcher import load_workflow_module
+
+    wf_path = os.path.join(d, f"wf_{name}.py")
+    with open(wf_path, "w") as f:
+        f.write(WF_TEXT)
+    mod = load_workflow_module(wf_path)
+
+    class FL:
+        workflow = None
+
+    prng.seed_all(seed)
+    w = mod.create_workflow(FL())
+    w.initialize(device=NumpyDevice())
+    base = {fw.name: {k: np.asarray(v) for k, v in
+                      fw.gather_params().items()}
+            for fw in w.forwards}
+    rng = np.random.default_rng(seed)
+    members = []
+    for _ in range(n_members):
+        params = {fn: {pn: (a + 0.05 * rng.standard_normal(a.shape)
+                            .astype(np.float32))
+                       for pn, a in p.items()}
+                  for fn, p in base.items()}
+        members.append({"params": params, "valid_error": 0.0,
+                        "seed": seed,
+                        "forward_names": [fw.name
+                                          for fw in w.forwards],
+                        "values": None})
+    pkg = os.path.join(d, f"{name}.vpkg")
+    pack_ensemble(pkg, name, members, wf_path)
+    return {"pkg": pkg, "members": members, "workflow": w}
+
+
+def _host_oracle(model, x):
+    """The numpy member-loop mean-probability oracle."""
+    acc = None
+    for m in model["members"]:
+        out = np.asarray(x, np.float32)
+        for fw in model["workflow"].forwards:
+            p = {k: np.asarray(v)
+                 for k, v in m["params"][fw.name].items()}
+            out, _ = fw.apply_fwd(p, out, rng=None, train=False)
+        out = np.asarray(out)
+        acc = out if acc is None else acc + out
+    return acc / len(model["members"])
+
+
+def _journal_events(metrics_dir, name):
+    out = []
+    if not os.path.isdir(metrics_dir):
+        return out
+    for fn in os.listdir(metrics_dir):
+        if not fn.startswith("journal-"):
+            continue
+        with open(os.path.join(metrics_dir, fn)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("event") == name:
+                    out.append(ev)
+    return out
+
+
+@pytest.fixture(scope="module")
+def packages(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("hive_pkgs"))
+    return {"alpha": _build_package(d, "alpha", 11),
+            "beta": _build_package(d, "beta", 22)}
+
+
+class TestMicroBatcher:
+    """In-process batching semantics (no subprocess)."""
+
+    def _batcher(self, dispatch, **kw):
+        from veles_tpu.serve.batcher import MicroBatcher
+        kw.setdefault("max_batch", 8)
+        kw.setdefault("max_wait_s", 0.05)
+        return MicroBatcher(dispatch, **kw)
+
+    def test_single_request_flushes_at_max_wait(self):
+        batches = []
+
+        def dispatch(xb):
+            batches.append(xb.shape)
+            return xb.sum(axis=tuple(range(1, xb.ndim)))
+
+        b = self._batcher(dispatch, max_batch=8, max_wait_s=0.02)
+        t0 = time.perf_counter()
+        out = b.submit(np.ones((2, 3))).result(timeout=5)
+        dt = time.perf_counter() - t0
+        assert out.shape == (2,) and np.allclose(out, 3.0)
+        # the lone request waited ~max_wait, not forever — and the
+        # dispatch shape is the FIXED max_batch chunk, zero-padded
+        assert dt < 2.0
+        assert batches == [(8, 3)]
+        b.close()
+
+    def test_concurrent_requests_coalesce_in_order(self):
+        sizes = []
+
+        def dispatch(xb):
+            sizes.append(len(xb))
+            return xb * 2.0
+
+        b = self._batcher(dispatch, max_batch=16, max_wait_s=0.25)
+        futs = [b.submit(np.full((2, 4), i, np.float32))
+                for i in range(4)]
+        outs = [f.result(timeout=5) for f in futs]
+        for i, out in enumerate(outs):
+            assert np.allclose(out, 2.0 * i), (i, out)
+        # 8 rows < max_batch: ONE flush carried all four requests
+        from veles_tpu import telemetry
+        assert sizes == [16]   # fixed shape (padded)
+        h = telemetry.histogram("serve.batch_rows")
+        assert h.max >= 8
+        b.close()
+
+    def test_oversized_request_splits_across_dispatches(self):
+        n_dispatches = []
+
+        def dispatch(xb):
+            n_dispatches.append(len(xb))
+            return xb + 1.0
+
+        b = self._batcher(dispatch, max_batch=4, max_wait_s=0.01)
+        rows = np.arange(10, dtype=np.float32).reshape(10, 1)
+        out = b.submit(rows).result(timeout=5)
+        assert out.shape == (10, 1)
+        assert np.allclose(out, rows + 1.0)
+        assert len(n_dispatches) == 3   # 4 + 4 + 2 rows
+        b.close()
+
+    def test_mismatched_sample_shape_bounces_at_submit(self):
+        b = self._batcher(lambda xb: xb, max_batch=4,
+                          max_wait_s=0.01, sample_shape=(3,))
+        with pytest.raises(ValueError):
+            b.submit(np.ones((2, 5), np.float32))
+        out = b.submit(np.ones((1, 3), np.float32)).result(timeout=5)
+        assert out.shape == (1, 3)
+        b.close()
+
+    def test_failed_dispatch_fails_only_its_batch(self):
+        calls = {"n": 0}
+
+        def dispatch(xb):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("boom")
+            return xb
+
+        b = self._batcher(dispatch, max_batch=4, max_wait_s=0.01)
+        f1 = b.submit(np.ones((1, 2), np.float32))
+        with pytest.raises(RuntimeError):
+            f1.result(timeout=5)
+        # the flush loop survived: the next request dispatches fine
+        out = b.submit(np.ones((1, 2), np.float32)).result(timeout=5)
+        assert out.shape == (1, 2)
+        b.close()
+
+    def test_drain_resolves_everything(self):
+        def dispatch(xb):
+            time.sleep(0.01)
+            return xb
+
+        b = self._batcher(dispatch, max_batch=2, max_wait_s=0.5)
+        futs = [b.submit(np.ones((1, 2), np.float32))
+                for _ in range(7)]
+        assert b.drain(timeout=10)
+        assert all(f.done() for f in futs)
+        b.close()
+
+
+class TestHiveRoundTrip:
+    """(a) oracle parity under N concurrent clients and (b) request
+    coalescing, through the real ``--serve-models`` CLI subprocess."""
+
+    @pytest.fixture(scope="class")
+    def client(self, packages, tmp_path_factory):
+        from veles_tpu.serve.client import HiveClient
+        mdir = str(tmp_path_factory.mktemp("hive_metrics"))
+        c = HiveClient(
+            {"alpha": packages["alpha"]["pkg"],
+             "beta": packages["beta"]["pkg"]},
+            backend="cpu", max_batch=16, max_wait_ms=20,
+            metrics_dir=mdir, cwd=REPO)
+        c.metrics_dir = mdir
+        yield c
+        c.close()
+
+    def test_hello_reports_models_resident(self, client):
+        h = client.hello
+        assert h["ready"] and h["platform"] == "cpu"
+        assert set(h["models"]) == {"alpha", "beta"}
+        for m in h["models"].values():
+            assert m["members"] == 3 and m["resident"]
+
+    def test_concurrent_responses_match_host_oracle(self, client,
+                                                    packages):
+        errs = []
+
+        def worker(i):
+            try:
+                rng = np.random.default_rng(100 + i)
+                name = "alpha" if i % 2 == 0 else "beta"
+                for _ in range(4):
+                    x = rng.standard_normal((2, 6, 6, 1)) \
+                        .astype(np.float32)
+                    r = client.request(name, x, timeout=60)
+                    assert "probs" in r, r
+                    got = np.asarray(r["probs"], np.float32)
+                    want = _host_oracle(packages[name], x)
+                    np.testing.assert_allclose(got, want, atol=1e-4)
+                    assert r["pred"] == list(
+                        np.argmax(want, axis=-1))
+            except Exception as e:  # noqa: BLE001 — collected below
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+
+    def test_requests_were_coalesced(self, client):
+        st = client.stats()
+        h = st["histograms"].get("serve.batch_rows")
+        assert h, "no serve.batch_rows histogram in the snapshot"
+        assert h["max"] > 1, h   # >1 row in one dispatch = coalesced
+        # latency histogram present with quantiles — the SLO surface
+        lat = st["histograms"]["serve.request_seconds"]
+        assert lat["count"] > 0 and lat["p99"] is not None
+        # batch-efficiency accounting: valid rows never exceed slots
+        c = st["counters"]
+        assert 0 < c["serve.rows"] <= c["serve.batch_slots"]
+
+    def test_steady_state_has_zero_recompiles(self, client):
+        before = client.stats()["counters"].get("serve.compiles", 0)
+        rng = np.random.default_rng(7)
+        for _ in range(5):
+            x = rng.standard_normal((3, 6, 6, 1)).astype(np.float32)
+            assert "probs" in client.request("alpha", x, timeout=60)
+        after = client.stats()["counters"].get("serve.compiles", 0)
+        # both models compiled exactly once (at their first dispatch);
+        # the warm window added nothing
+        assert after == before
+        assert after <= 2
+
+    def test_bad_requests_answer_errors_not_death(self, client):
+        r = client.request("nosuch", np.ones((1, 6, 6, 1)))
+        assert "error" in r and "nosuch" in r["error"]
+        r = client.request("alpha", np.ones((1, 3, 3, 1)))
+        assert "error" in r
+        # the process is still serving
+        r = client.request("alpha", np.ones((1, 6, 6, 1)))
+        assert "probs" in r
+
+
+class TestHiveSigtermDrain:
+    """(c) SIGTERM finishes in-flight requests, journals the drain,
+    and exits 14 so --supervise resumes it."""
+
+    def test_sigterm_drains_and_exits_14(self, packages,
+                                         tmp_path_factory):
+        from veles_tpu.serve.client import HiveClient
+        mdir = str(tmp_path_factory.mktemp("hive_term"))
+        c = HiveClient({"alpha": packages["alpha"]["pkg"]},
+                       backend="cpu", max_batch=8, max_wait_ms=50,
+                       metrics_dir=mdir, cwd=REPO)
+        try:
+            x = np.ones((1, 6, 6, 1), np.float32)
+            assert "probs" in c.request("alpha", x)   # warm
+            ids = [c.submit("alpha", x) for _ in range(12)]
+            c.sigterm()
+            for jid in ids:
+                r = c.wait_for(jid, timeout=60)
+                assert "probs" in r, r   # drained, not dropped
+            rc = c.wait(60)
+        finally:
+            c.close(kill=True)
+        from veles_tpu.supervisor import EXIT_PREEMPTED
+        assert rc == EXIT_PREEMPTED
+        drains = _journal_events(mdir, "serve.drain")
+        assert drains and drains[-1]["complete"] is True
+        downs = _journal_events(mdir, "serve.shutdown")
+        assert downs and downs[-1]["reason"] == "SIGTERM"
+        assert downs[-1]["code"] == EXIT_PREEMPTED
+
+
+class TestHiveResidency:
+    """(d) an over-budget model load spills the LRU model to host,
+    journals every transition, and spilled models still answer
+    (restore = re-upload, not recompile)."""
+
+    def test_lru_spill_restore_roundtrip(self, packages,
+                                         tmp_path_factory):
+        from veles_tpu.serve.client import HiveClient
+        mdir = str(tmp_path_factory.mktemp("hive_lru"))
+        one_model = packages["alpha"]["members"]
+        bytes_one = sum(
+            int(np.prod(a.shape)) * 4
+            for m in one_model for p in m["params"].values()
+            for a in p.values())
+        c = HiveClient(
+            {"alpha": packages["alpha"]["pkg"],
+             "beta": packages["beta"]["pkg"]},
+            backend="cpu", max_batch=8, max_wait_ms=5,
+            hbm_budget=bytes_one + 64,   # fits exactly one model
+            metrics_dir=mdir, cwd=REPO)
+        try:
+            assert sum(m["resident"]
+                       for m in c.hello["models"].values()) == 1
+            x = np.ones((2, 6, 6, 1), np.float32)
+            for name in ("alpha", "beta", "alpha", "beta"):
+                r = c.request(name, x, timeout=60)
+                assert "probs" in r, (name, r)
+                want = _host_oracle(packages[name], x)
+                np.testing.assert_allclose(
+                    np.asarray(r["probs"]), want, atol=1e-4)
+            st = c.stats()
+            assert st["gauges"]["serve.models_resident"] == 1
+            assert st["counters"]["serve.spills"] >= 2
+        finally:
+            c.close()
+        spills = _journal_events(mdir, "serve.model_spilled")
+        loads = _journal_events(mdir, "serve.model_loaded")
+        restores = _journal_events(mdir, "serve.model_restored")
+        assert len(loads) == 2
+        assert spills, "no serve.model_spilled journal event"
+        assert restores, "no serve.model_restored journal event"
+        assert {e["model"] for e in spills} >= {"alpha"}
+
+
+class TestEngineSubmitApi:
+    """The request-level EnsembleEvalEngine facade in-process: the
+    refactor the serving tier rides (submit -> Future instead of
+    whole-dataset calls)."""
+
+    def test_submit_without_batcher_raises(self, packages):
+        from veles_tpu.backends import JaxDevice
+        from veles_tpu.ops.fused import EnsembleEvalEngine
+        model = packages["alpha"]
+        eng = EnsembleEvalEngine(
+            model["workflow"].forwards,
+            [m["params"] for m in model["members"]],
+            JaxDevice(platform="cpu"))
+        with pytest.raises(RuntimeError):
+            eng.submit(np.ones((1, 6, 6, 1), np.float32))
+        eng.release()
+
+    def test_submit_matches_predict_proba(self, packages):
+        from veles_tpu.backends import JaxDevice
+        from veles_tpu.ops.fused import EnsembleEvalEngine
+        model = packages["alpha"]
+        eng = EnsembleEvalEngine(
+            model["workflow"].forwards,
+            [m["params"] for m in model["members"]],
+            JaxDevice(platform="cpu"))
+        eng.attach_batcher(max_batch=8, max_wait_s=0.01)
+        x = np.random.default_rng(3).standard_normal(
+            (5, 6, 6, 1)).astype(np.float32)
+        got = eng.submit(x).result(timeout=30)
+        want = _host_oracle(model, x)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+        # spill/restore keeps answers identical (and the jit cache)
+        eng.spill_params()
+        assert not eng.resident
+        eng.restore_params([m["params"] for m in model["members"]])
+        got2 = eng.submit(x).result(timeout=30)
+        np.testing.assert_allclose(got2, want, atol=1e-4)
+        eng.release()

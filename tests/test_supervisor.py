@@ -347,6 +347,71 @@ class TestGracefulStopBoundary:
         assert len([h for h in w.decision.history
                     if h["class"] == "validation"]) == 1
 
+    def test_mid_class_stop_preserves_partial_metrics(self, tmp_path):
+        """A class spanning SEVERAL superstep firings can be stopped
+        BETWEEN them (any iteration boundary is a legal stop point).
+        The fused runner's on-device metric accumulator must ride the
+        snapshot: before the _acc/_conf carry existed, the resumed
+        epoch's history row counted only post-resume minibatches — the
+        chaos drill's load-sensitive `preempt.sigterm_resume`
+        hist-parity flake (weights were exact; metrics were not)."""
+        from veles_tpu import prng
+        from veles_tpu.backends import JaxDevice
+        from veles_tpu.datasets import synthetic_classification
+        from veles_tpu.loader import ArrayLoader
+        from veles_tpu.ops.standard_workflow import StandardWorkflow
+        from veles_tpu.snapshotter import load_workflow, save_workflow
+
+        def build(max_epochs=3):
+            prng.seed_all(2468)
+            # 480/20 = 24 train minibatches = THREE superstep-8
+            # firings per class: firings 1 and 2 end mid-class
+            train, valid, _ = synthetic_classification(
+                480, 40, (8, 8, 1), n_classes=4, seed=9)
+            gd = {"learning_rate": 0.1, "gradient_moment": 0.9}
+            return StandardWorkflow(
+                loader_factory=lambda w: ArrayLoader(
+                    w, train=train, valid=valid, minibatch_size=20,
+                    name="loader"),
+                layers=[
+                    {"type": "all2all_tanh",
+                     "->": {"output_sample_shape": 16}, "<-": gd},
+                    {"type": "softmax",
+                     "->": {"output_sample_shape": 4}, "<-": gd},
+                ],
+                decision_config={"max_epochs": max_epochs},
+                name="midclass_wf")
+
+        ref = build()
+        ref.initialize(device=JaxDevice(platform="cpu"))
+        ref.run()
+        ref_hist = [(h["class"], h["n_err"], float(h["loss"]))
+                    for h in ref.decision.history]
+
+        w1 = build()
+        w1.initialize(device=JaxDevice(platform="cpu"))
+        orig, calls = w1.loader.run, {"n": 0}
+
+        def counting():
+            orig()
+            calls["n"] += 1
+            if calls["n"] == 2:     # mid-TRAIN-class, mid-epoch 1
+                w1.request_stop()
+        w1.loader.run = counting
+        w1.run()
+        del w1.loader.__dict__["run"]
+        assert w1.stop_requested
+        assert not bool(w1.loader.class_ended)   # genuinely mid-class
+        path = str(tmp_path / "midclass.pickle.gz")
+        save_workflow(w1, path)
+
+        w2 = load_workflow(path)
+        w2.initialize(device=JaxDevice(platform="cpu"))
+        w2.run()
+        got_hist = [(h["class"], h["n_err"], float(h["loss"]))
+                    for h in w2.decision.history]
+        assert got_hist == ref_hist
+
 
 class TestFinalSnapshotLineage:
     def test_final_snapshot_lands_in_lineage_with_manifest(

@@ -107,3 +107,54 @@ class TestWorkflowReporting:
         assert row["epoch"] == 3
         assert row["complete"] is True
         assert row["valid_error_pct"] < 100.0
+
+
+class TestMetricsDashboard:
+    """Sightline mode: --metrics-dir renders LIVE telemetry through
+    the obs_report internals instead of the legacy push feed."""
+
+    @pytest.fixture
+    def metrics_dir(self, tmp_path):
+        from veles_tpu import events, telemetry
+        telemetry.configure(str(tmp_path))
+        telemetry.counter(events.CTR_SERVE_REQUESTS).inc(7)
+        telemetry.gauge(events.GAUGE_SERVE_MODELS_RESIDENT).set(2)
+        telemetry.histogram(events.HIST_SERVE_REQUEST_SECONDS) \
+            .record(0.004)
+        telemetry.event(events.EV_SERVE_READY, pid=123,
+                        platform="cpu")
+        telemetry.flush()
+        yield str(tmp_path)
+        telemetry.configure(None)
+
+    @pytest.fixture
+    def mserver(self, metrics_dir):
+        s = WebStatusServer(port=0, host="127.0.0.1",
+                            metrics_dir=metrics_dir)
+        s.start_background()
+        yield s
+        s.shutdown()
+
+    def test_dashboard_renders_live_telemetry(self, mserver):
+        with urllib.request.urlopen(url(mserver, "/"), timeout=5) as r:
+            page = r.read().decode()
+        assert "serve.requests" in page
+        assert "serve.request_seconds" in page
+        assert "serve.ready" in page          # journal timeline
+        assert "live telemetry" in page
+
+    def test_api_metrics_returns_merged_snapshot(self, mserver):
+        snap = get_json(mserver, "/api/metrics")
+        assert snap["counters"]["serve.requests"] == 7
+        assert snap["gauges"]["serve.models_resident"] == 2
+        assert snap["histograms"]["serve.request_seconds"]["count"] \
+            == 1
+        assert snap["snapshots"] >= 1
+
+    def test_legacy_push_feed_still_reachable(self, mserver):
+        # /api/status and /api/update keep working in Sightline mode
+        body = json.dumps({"id": "r1", "name": "w",
+                           "epoch": 1}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            url(mserver, "/api/update"), data=body), timeout=5)
+        assert get_json(mserver, "/api/status")["r1"]["epoch"] == 1

@@ -149,6 +149,14 @@ def main(argv=None) -> int:
         # the child re-parses the identical argv minus the flag
         from veles_tpu import supervisor
         return supervisor.run([a for a in argv if a != "--supervise"])
+    if "--serve-models" in argv:
+        # the Hive serving process (docs/guide.md "Online serving"):
+        # its model specs are NAME=PKG pairs, not workflow files, so it
+        # owns its own parser — intercepted like --supervise (and
+        # composable with it: a supervised hive exits 14 on SIGTERM
+        # and is resumed with warm caches)
+        from veles_tpu.serve import hive
+        return hive.main([a for a in argv if a != "--serve-models"])
     # root.* overrides can appear anywhere; apply AFTER config files,
     # so collect them first but apply later.
     overrides = [a for a in argv if a.startswith("root.") and "=" in a]

@@ -1,5 +1,9 @@
 """Slave client: zmq master--slave data parallelism (DCN compat mode).
 
+**LEGACY surface** (see server.py — same status): kept for reference
+parity; SPMD over the mesh is the training-scale path and the Hive
+serving tier (veles_tpu/serve) is the online-inference one.
+
 Reference parity: veles/client.py — connect, handshake, pull a job,
 apply master data, run ONE iteration on the local device, send the
 update back (SURVEY.md §4.2).  The iteration here is the fused jitted

@@ -96,6 +96,14 @@ EV_MULTIHOST_COLLECTIVE_FAILED = _ev("multihost.collective_failed")
 EV_MULTIHOST_PEER_DEATH = _ev("multihost.peer_death")
 EV_MULTIHOST_INIT_REFUSED = _ev("multihost.init_refused")
 
+EV_SERVE_READY = _ev("serve.ready")
+EV_SERVE_MODEL_LOADED = _ev("serve.model_loaded")
+EV_SERVE_MODEL_SPILLED = _ev("serve.model_spilled")
+EV_SERVE_MODEL_RESTORED = _ev("serve.model_restored")
+EV_SERVE_FIRST_DISPATCH = _ev("serve.first_dispatch")
+EV_SERVE_DRAIN = _ev("serve.drain")
+EV_SERVE_SHUTDOWN = _ev("serve.shutdown")
+
 EV_SUPERVISOR_RESTART = _ev("supervisor.restart")
 EV_SUPERVISOR_RESUMED = _ev("supervisor.resumed")
 EV_SUPERVISOR_SHUTDOWN = _ev("supervisor.shutdown")
@@ -126,6 +134,15 @@ CTR_GA_GENOMES_LOST = _ctr("ga.genomes_lost")
 CTR_GA_GENOME_RETRIES = _ctr("ga.genome_retries")
 CTR_GA_CHECKPOINT_FALLBACKS = _ctr("ga.checkpoint_fallbacks")
 
+CTR_SERVE_REQUESTS = _ctr("serve.requests")
+CTR_SERVE_REQUEST_ERRORS = _ctr("serve.request_errors")
+CTR_SERVE_ROWS = _ctr("serve.rows")
+CTR_SERVE_MEMBER_ROWS = _ctr("serve.member_rows")
+CTR_SERVE_BATCHES = _ctr("serve.batches")
+CTR_SERVE_BATCH_SLOTS = _ctr("serve.batch_slots")
+CTR_SERVE_COMPILES = _ctr("serve.compiles")
+CTR_SERVE_SPILLS = _ctr("serve.spills")
+
 CTR_EVALUATOR_JOBS = _ctr("evaluator.jobs")
 CTR_EVALUATOR_JOB_ERRORS = _ctr("evaluator.job_errors")
 
@@ -149,6 +166,12 @@ GAUGE_FUSED_TRAIN_GFLOPS_PER_IMAGE = _gauge(
     "fused.train_gflops_per_image")
 GAUGE_FUSED_TRAIN_IMAGES_PER_SEC_WALL = _gauge(
     "fused.train_images_per_sec_wall")
+GAUGE_SERVE_QUEUE_DEPTH = _gauge("serve.queue_depth")
+GAUGE_SERVE_MODELS_RESIDENT = _gauge("serve.models_resident")
+GAUGE_SERVE_RESIDENT_BYTES = _gauge("serve.resident_bytes")
+GAUGE_SERVE_FIRST_DISPATCH_SECONDS = _gauge(
+    "serve.first_dispatch_seconds")
+
 GAUGE_GA_LAST_HANG_WAIT = _gauge("ga.last_hang_wait")
 GAUGE_PREEMPT_SNAPSHOT_SECONDS = _gauge("preempt.snapshot_seconds")
 GAUGE_MULTIHOST_PEER_HEARTBEAT_AGE = _gauge(
@@ -166,6 +189,10 @@ HIST_ENSEMBLE_DISPATCH_SECONDS = _hist("ensemble.dispatch_seconds")
 HIST_ENSEMBLE_SCORE_SECONDS = _hist("ensemble.score_seconds")
 HIST_SUPERVISOR_DOWNTIME_SECONDS = _hist(
     "supervisor.downtime_seconds")
+HIST_SERVE_REQUEST_SECONDS = _hist("serve.request_seconds")
+HIST_SERVE_DISPATCH_SECONDS = _hist("serve.dispatch_seconds")
+HIST_SERVE_BATCH_ROWS = _hist("serve.batch_rows")
+HIST_SERVE_WAIT_SECONDS = _hist("serve.wait_seconds")
 
 # -- journaled spans (event + histogram of the same name) --------------
 

@@ -139,6 +139,24 @@ TPU_GA_HBM_BUDGET = _knob(
     "HBM byte budget for population-batched cohort sizing when the "
     "device reports no bytes_limit.")
 
+# -- online serving (Hive) ---------------------------------------------
+
+SERVE_MAX_WAIT_MS = _knob(
+    "VELES_SERVE_MAX_WAIT_MS", 5.0, float,
+    "Longest a queued serving request may wait for co-batchable "
+    "traffic before its micro-batch dispatches anyway (the "
+    "latency/throughput tradeoff knob of veles_tpu/serve).")
+SERVE_MAX_BATCH = _knob(
+    "VELES_SERVE_MAX_BATCH", 64, int,
+    "Rows per serving micro-batch: the batcher flushes as soon as "
+    "this many rows coalesce (also the ONE fixed dispatch shape — "
+    "zero steady-state recompiles).")
+SERVE_HBM_BUDGET = _knob(
+    "VELES_SERVE_HBM_BUDGET", 8 << 30, int,
+    "HBM byte budget for resident serving models when the device "
+    "reports no bytes_limit; over budget the LRU model spills to "
+    "host.")
+
 # -- observability -----------------------------------------------------
 
 METRICS_DIR = _knob(

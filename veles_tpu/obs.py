@@ -1,0 +1,150 @@
+"""Sightline dir readers/renderers — the importable internals behind
+``scripts/obs_report.py`` (the CLI) and ``web_status.py --metrics-dir``
+(the live dashboard).
+
+Reads every per-process snapshot (``metrics-*.json``) in a metrics
+dir, merges them bucket-wise into ONE aggregate registry (skipping
+``*.merged`` files — those were already folded into a parent's
+snapshot by ``ChipEvaluatorPool``, and re-adding them would double
+count), interleaves every process's journal (``journal-*.jsonl``) into
+one timeline, and renders the counter/gauge tables, per-histogram
+quantile tables, derived per-engine throughput, and the event
+timeline.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+
+from veles_tpu.telemetry import Registry
+
+#: (label, images counter, seconds counter, unit) rows of the derived
+#: throughput table — only pairs present in the merged registry print
+THROUGHPUT_ROWS = (
+    ("fused train", "fused.train_images", "fused.train_seconds",
+     "img/s"),
+    ("fused eval", "fused.eval_images", "fused.eval_seconds", "img/s"),
+    ("ensemble", "ensemble.member_images", "ensemble.seconds",
+     "member-img/s"),
+    ("ga", "ga.evaluations", "ga.eval_seconds", "genomes/s"),
+    ("serve", "serve.rows", "serve.dispatch_seconds", "rows/s"),
+)
+
+
+def load_dir(metrics_dir: str):
+    """(merged Registry, [snapshot paths], [journal paths], [journal
+    events sorted by ts]) for a metrics dir."""
+    reg = Registry()
+    snaps = []
+    for path in sorted(glob.glob(os.path.join(metrics_dir,
+                                              "metrics-*.json"))):
+        if path.endswith(".merged") or path.endswith(".tmp"):
+            continue
+        try:
+            with open(path) as f:
+                reg.merge_snapshot(json.load(f))
+            snaps.append(path)
+        except (OSError, ValueError) as e:
+            print(f"obs_report: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+    events = []
+    journals = sorted(glob.glob(os.path.join(metrics_dir,
+                                             "journal-*.jsonl")))
+    for path in journals:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue   # torn tail line of a killed process
+                    ev["_pid"] = os.path.basename(path).split("-")[-1] \
+                        .split(".")[0]
+                    events.append(ev)
+        except OSError:
+            continue
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return reg, snaps, journals, events
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v != 0 and (abs(v) < 1e-3 or abs(v) >= 1e6):
+            return f"{v:.3e}"
+        return f"{v:,.4f}".rstrip("0").rstrip(".")
+    return f"{v:,}"
+
+
+def render(metrics_dir: str, reg: Registry, snaps, journals, events,
+           max_events: int = 40) -> str:
+    out = [f"== Sightline report: {metrics_dir} ==",
+           f"{len(snaps)} process snapshot(s), {len(journals)} "
+           f"journal(s), {len(events)} event(s)", ""]
+
+    counters = {n: c.value for n, c in sorted(reg.counters.items())
+                if c.value}
+    if counters:
+        w = max(len(n) for n in counters)
+        out.append("-- counters --")
+        out += [f"  {n:<{w}}  {_fmt(v)}" for n, v in counters.items()]
+        out.append("")
+
+    gauges = {n: g.value for n, g in sorted(reg.gauges.items())
+              if g.value is not None}
+    if gauges:
+        w = max(len(n) for n in gauges)
+        out.append("-- gauges --")
+        out += [f"  {n:<{w}}  {_fmt(v)}" for n, v in gauges.items()]
+        out.append("")
+
+    hists = {n: h for n, h in sorted(reg.histograms.items())
+             if h.count}
+    if hists:
+        w = max(len(n) for n in hists)
+        out.append("-- histograms (p50/p90/p99 from log buckets) --")
+        out.append(f"  {'name':<{w}}  {'count':>8} {'mean':>11} "
+                   f"{'p50':>11} {'p90':>11} {'p99':>11} {'max':>11}")
+        for n, h in hists.items():
+            out.append(
+                f"  {n:<{w}}  {h.count:>8} {_fmt(h.mean):>11} "
+                f"{_fmt(h.quantile(0.5)):>11} "
+                f"{_fmt(h.quantile(0.9)):>11} "
+                f"{_fmt(h.quantile(0.99)):>11} {_fmt(h.max):>11}")
+        out.append("")
+
+    rows = []
+    for label, num, den, unit in THROUGHPUT_ROWS:
+        n = counters.get(num)
+        d = counters.get(den)
+        if n and d:
+            rows.append(f"  {label}: {_fmt(n)} over "
+                        f"{_fmt(d)} engine-s -> "
+                        f"{_fmt(n / d)} {unit}")
+    if rows:
+        out.append("-- derived throughput (per engine-second) --")
+        out += rows
+        out.append("")
+
+    if events:
+        shown = events[-max_events:]
+        out.append(f"-- journal timeline (last {len(shown)} of "
+                   f"{len(events)}) --")
+        for ev in shown:
+            ts = time.strftime("%H:%M:%S",
+                               time.localtime(ev.get("ts", 0)))
+            fields = " ".join(
+                f"{k}={_fmt(v) if isinstance(v, (int, float)) else v}"
+                for k, v in ev.items()
+                if k not in ("ts", "event", "_pid"))
+            out.append(f"  {ts} [{ev.get('_pid', '?')}] "
+                       f"{ev.get('event', '?')} {fields}".rstrip())
+    return "\n".join(out)

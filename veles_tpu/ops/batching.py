@@ -1,0 +1,129 @@
+"""Shared fixed-shape batching machinery of the fused engines.
+
+FusedStepRunner, EnsembleEvalEngine, and PopulationTrainEngine (and
+now the Hive serving tier) all rely on the same three mechanical
+ideas, which used to live as near-identical private helpers inside the
+ever-growing ops/fused.py:
+
+- **member stacking**: N param pytrees stacked along a leading MEMBER
+  axis and uploaded once, so ``jax.vmap`` turns an N-member sweep into
+  one dispatch;
+- **fixed-shape chunk + validity mask**: every dispatch sees the SAME
+  array shape (ragged tails are zero-padded and masked out of the
+  math), so a jitted step compiles exactly once per step kind — the
+  property the serving tier's zero-recompile steady state rests on;
+- **compute-dtype resolution + pytree casting**: matmuls/convs run in
+  the device's compute dtype (bf16 on TPU) against f32 master params;
+  each engine resolves the dtype the same way and casts the same way.
+
+This module is the single home for all three (a concrete down payment
+on the ROADMAP's "unify the fused engines" item): the engines import
+from here, behavior unchanged — pinned by their existing parity tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+
+def resolve_compute_dtype(compute_dtype: Any, device: Any):
+    """The jnp dtype an engine computes in: an explicit
+    ``compute_dtype`` wins, else the device's policy (bf16 on TPU, f32
+    elsewhere), else float32."""
+    import jax.numpy as jnp
+    cd = compute_dtype
+    if cd is None and device is not None:
+        cd = device.compute_dtype
+    return jnp.dtype(cd) if cd is not None else jnp.float32
+
+
+def make_caster(cd):
+    """``cast(tree)`` mapping every f32 leaf to ``cd`` (identity when
+    ``cd`` IS f32) — the mixed-precision entry every engine applies to
+    its param pytree before the forward chain."""
+    import jax
+    import jax.numpy as jnp
+    if cd == jnp.float32:
+        return lambda tree: tree
+
+    def cast(tree):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(cd) if a.dtype == jnp.float32 else a,
+            tree)
+    return cast
+
+
+def stack_member_params(forwards: List[Any],
+                        member_params: List[Dict[str, Dict[str, Any]]],
+                        device: Any) -> Dict[str, Dict[str, Any]]:
+    """{fwd_name: {pname: (n_members, ...)}} — every member's f32
+    params stacked along a leading MEMBER axis and uploaded once.
+    Shared by the vmapped engines: EnsembleEvalEngine stacks N distinct
+    trained members; PopulationTrainEngine stacks P copies of one init
+    (same-signature genomes share the weight-init draw by seed); the
+    Hive residency manager re-uploads a spilled model through it."""
+    return {
+        f.name: {
+            pn: device.put(np.stack(
+                [np.asarray(m[f.name][pn], np.float32)
+                 for m in member_params]))
+            for pn in member_params[0][f.name]}
+        for f in forwards}
+
+
+def stacked_param_bytes(member_params:
+                        List[Dict[str, Dict[str, Any]]]) -> int:
+    """HBM bytes :func:`stack_member_params` will occupy for these
+    members (f32) — the residency-budget accounting the serving tier's
+    LRU spill decisions read, computed host-side BEFORE any upload."""
+    total = 0
+    for m in member_params:
+        for p in m.values():
+            for arr in p.values():
+                total += int(np.prod(np.shape(arr))) * 4
+    return total
+
+
+def pad_chunk(xb: np.ndarray, lb: np.ndarray,
+              chunk: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Fixed-shape (rows, labels) chunk + validity mask: the consuming
+    jit compiles exactly once; padded rows carry mask 0 and cannot
+    score."""
+    mask = np.ones(chunk, np.float32)
+    if len(xb) < chunk:
+        pad = chunk - len(xb)
+        mask[len(xb):] = 0.0
+        xb = np.concatenate(
+            [xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+        lb = np.concatenate([lb, np.zeros(pad, lb.dtype)])
+    return xb, lb, mask
+
+
+def pad_rows(x: np.ndarray,
+             chunk: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Label-less variant of :func:`pad_chunk` — the serving tier's
+    micro-batch assembly: rows zero-padded to the fixed ``chunk``
+    shape plus the validity mask (padded rows are discarded host-side
+    after the dispatch)."""
+    mask = np.ones(chunk, np.float32)
+    if len(x) < chunk:
+        pad = chunk - len(x)
+        mask[len(x):] = 0.0
+        x = np.concatenate(
+            [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+    return x, mask
+
+
+def padded_index_chunk(start: int, stop: int, chunk: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Fixed-shape index window [start, stop) + validity mask for the
+    resident gather paths (indices are padded with 0 — a valid row
+    index — and masked out of the scoring math)."""
+    idx = np.arange(start, stop, dtype=np.int32)
+    mask = np.ones(chunk, np.float32)
+    if len(idx) < chunk:
+        mask[len(idx):] = 0.0
+        idx = np.pad(idx, (0, chunk - len(idx)))
+    return idx, mask

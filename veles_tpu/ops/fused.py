@@ -35,6 +35,7 @@ import numpy as np
 from veles_tpu.accelerated_units import AcceleratedUnit
 from veles_tpu.loader.base import TRAIN
 from veles_tpu import events, prng, telemetry
+from veles_tpu.ops import batching
 
 
 class FusedStepRunner(AcceleratedUnit):
@@ -170,11 +171,8 @@ class FusedStepRunner(AcceleratedUnit):
         return (1, 1)
 
     def _resolved_dtype(self):
-        import jax.numpy as jnp
-        cd = self.compute_dtype
-        if cd is None and self.device is not None:
-            cd = self.device.compute_dtype
-        return jnp.dtype(cd) if cd is not None else jnp.float32
+        return batching.resolve_compute_dtype(self.compute_dtype,
+                                              self.device)
 
     def _build_steps(self) -> None:
         import jax
@@ -208,12 +206,7 @@ class FusedStepRunner(AcceleratedUnit):
                 return x
             return x.astype(jnp.float32) * q_scale + q_bias
 
-        def cast(tree):
-            if not mixed:
-                return tree
-            return jax.tree_util.tree_map(
-                lambda a: a.astype(cd) if a.dtype == jnp.float32 else a,
-                tree)
+        cast = batching.make_caster(cd)
 
         def forward_pass(params, x, rng_counter, train: bool):
             residuals = []
@@ -803,6 +796,17 @@ class FusedStepRunner(AcceleratedUnit):
         d.pop("_dispatch_seen", None)
         d.pop("_first_run_ts", None)
         d["stream_transfer_bytes"] = self.stream_transfer_bytes
+        # the on-device metric/confusion accumulators are device
+        # buffers (hence _unpicklable), but their VALUES are run state:
+        # a graceful-stop snapshot taken mid-class (Phoenix preemption
+        # lands at any iteration boundary, not only at class ends)
+        # would otherwise silently zero the partial class metrics and
+        # the resumed epoch's history row undercounts — the
+        # chaos-drill hist-parity flake.  Host-materialize them into
+        # the snapshot; __setstate__ feeds them back as the carry.
+        if self._acc is not None:
+            d["_acc_carry"] = np.asarray(self._acc)
+            d["_conf_carry"] = np.asarray(self._conf)
         return d
 
     def __setstate__(self, state: dict) -> None:
@@ -822,6 +826,14 @@ class FusedStepRunner(AcceleratedUnit):
         self._stream_bytes = int(restored)
         self._dispatch_seen = set()
         self._first_run_ts = None
+        # mid-class metric carry written by __getstate__ (absent in
+        # pre-fix snapshots): plain numpy arrays are exactly what
+        # run() hands a fresh dispatch, so resume continues the class
+        # accumulation where the stop left it
+        acc = self.__dict__.pop("_acc_carry", None)
+        conf = self.__dict__.pop("_conf_carry", None)
+        if acc is not None:
+            self._acc, self._conf = acc, conf
         from collections import deque
         if self.__dict__.get("_inflight") is None:  # dropped by pickle
             self._inflight = deque()
@@ -871,22 +883,27 @@ class EnsembleEvalEngine:
         self.n_members = len(member_params)
         self.compute_dtype = compute_dtype
         #: stacked params: {fwd_name: {pname: (n_members, ...)}} in HBM
-        self._params = _stack_member_params(self.forwards, member_params,
-                                            device)
+        self._params = batching.stack_member_params(
+            self.forwards, member_params, device)
+        #: HBM bytes the stacked f32 params occupy — the serving
+        #: tier's residency-budget accounting
+        self.param_bytes = batching.stacked_param_bytes(member_params)
         self._dataset = None
         self._labels = None
         self._predict = None
         self._score = None
         self._predict_resident = None
         self._score_resident = None
+        #: request-level serving facade (attach_batcher); dispatch
+        #: shapes seen so far split the compile firing out of the
+        #: steady-state latency histogram (the PR-7 convention)
+        self._batcher = None
+        self._served_shapes: set = set()
         self._build()
 
     def _resolved_dtype(self):
-        import jax.numpy as jnp
-        cd = self.compute_dtype
-        if cd is None:
-            cd = self.device.compute_dtype
-        return jnp.dtype(cd) if cd is not None else jnp.float32
+        return batching.resolve_compute_dtype(self.compute_dtype,
+                                              self.device)
 
     def _build(self) -> None:
         import jax
@@ -895,13 +912,7 @@ class EnsembleEvalEngine:
         forwards = self.forwards
         cd = self._resolved_dtype()
         mixed = cd != jnp.float32
-
-        def cast(tree):
-            if not mixed:
-                return tree
-            return jax.tree_util.tree_map(
-                lambda a: a.astype(cd) if a.dtype == jnp.float32 else a,
-                tree)
+        cast = batching.make_caster(cd)
 
         def member_forward(params, x):
             # ONE member's pure inference chain — the same apply_fwd
@@ -980,8 +991,9 @@ class EnsembleEvalEngine:
         t0 = time.perf_counter()
         n_chunks = 0
         for i in range(0, len(x), chunk):
-            xb, lb, mask = _pad_chunk(x[i:i + chunk],
-                                      labels[i:i + chunk], chunk)
+            xb, lb, mask = batching.pad_chunk(x[i:i + chunk],
+                                              labels[i:i + chunk],
+                                              chunk)
             acc = self._score(self._params, acc, self.device.put(xb),
                               self.device.put(lb),
                               self.device.put(mask))
@@ -1039,11 +1051,8 @@ class EnsembleEvalEngine:
         t0 = time.perf_counter()
         n_chunks = 0
         for i in range(0, total, chunk):
-            idx = np.arange(i, min(i + chunk, total), dtype=np.int32)
-            mask = np.ones(chunk, np.float32)
-            if len(idx) < chunk:
-                mask[len(idx):] = 0.0
-                idx = np.pad(idx, (0, chunk - len(idx)))
+            idx, mask = batching.padded_index_chunk(
+                i, min(i + chunk, total), chunk)
             acc = self._score_resident(
                 self._params, acc, self._dataset, self._labels,
                 self.device.put(idx), self.device.put(mask))
@@ -1052,9 +1061,98 @@ class EnsembleEvalEngine:
         self._record_score(time.perf_counter() - t0, n_chunks, total)
         return 100.0 * float(acc[0]) / max(float(acc[1]), 1.0)
 
+    # -- request-level serving (Hive) ----------------------------------
+
+    def attach_batcher(self, max_batch: int, max_wait_s: float,
+                       label: str = "ensemble", sample_shape=None):
+        """Arm the request-level API: concurrent :meth:`submit` calls
+        coalesce into ONE fixed-shape mask-padded dispatch of up to
+        ``max_batch`` rows, flushed after ``max_wait_s`` at the
+        latest.  The serving tier (veles_tpu/serve) drives every model
+        through this facade; ``submit`` raises until it is armed."""
+        from veles_tpu.serve.batcher import MicroBatcher
+        if self._batcher is not None:
+            return self._batcher
+        self._batcher = MicroBatcher(self._serve_dispatch,
+                                     max_batch=max_batch,
+                                     max_wait_s=max_wait_s,
+                                     label=label,
+                                     sample_shape=sample_shape)
+        return self._batcher
+
+    def submit(self, rows: np.ndarray):
+        """Request-level inference: enqueue ``rows`` (one request of
+        one or more samples) and return a ``concurrent.futures.Future``
+        resolving to the mean member probabilities for exactly those
+        rows.  The micro-batching loop coalesces concurrent requests —
+        this is the serving tier's whole-dataset-free entry point."""
+        if self._batcher is None:
+            raise RuntimeError("attach_batcher() first — submit() is "
+                               "the micro-batched serving API")
+        return self._batcher.submit(rows)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every submitted request has resolved (the
+        SIGTERM drain path).  Returns False on timeout."""
+        if self._batcher is None:
+            return True
+        return self._batcher.drain(timeout)
+
+    def _serve_dispatch(self, xb: np.ndarray) -> np.ndarray:
+        """One fixed-shape serving dispatch (the batcher's flush
+        callback).  The FIRST firing of each batch shape traces +
+        compiles and lands in its own gauge/journal entry (the PR-7
+        compile split), so the steady-state latency histogram stays
+        clean — and a nonzero ``serve.compiles`` delta across a warm
+        window is a recompile regression."""
+        import time
+        t0 = time.perf_counter()
+        out = np.asarray(self._predict(self._params,
+                                       self.device.put(xb)))
+        dt = time.perf_counter() - t0
+        if telemetry.enabled():
+            shape = tuple(xb.shape)
+            if shape not in self._served_shapes:
+                self._served_shapes.add(shape)
+                telemetry.counter(events.CTR_SERVE_COMPILES).inc()
+                telemetry.gauge(
+                    events.GAUGE_SERVE_FIRST_DISPATCH_SECONDS).set(
+                    round(dt, 4))
+                telemetry.event(events.EV_SERVE_FIRST_DISPATCH,
+                                rows=int(shape[0]),
+                                seconds=round(dt, 4))
+            else:
+                telemetry.histogram(
+                    events.HIST_SERVE_DISPATCH_SECONDS).record(dt)
+            telemetry.counter(events.CTR_SERVE_MEMBER_ROWS).inc(
+                int(xb.shape[0]) * self.n_members)
+        return out
+
+    def spill_params(self) -> None:
+        """Drop the stacked device params (LRU residency spill) while
+        keeping the compiled dispatchers — :meth:`restore_params`
+        re-uploads without retracing, so a restored model's first
+        request pays one H2D transfer, not a recompile."""
+        self._params = None
+
+    def restore_params(self, member_params: List[Dict[str, Dict[
+            str, Any]]]) -> None:
+        """Re-upload spilled member params (the residency manager
+        keeps the host copies — model params are immutable while
+        serving)."""
+        self._params = batching.stack_member_params(
+            self.forwards, member_params, self.device)
+
+    @property
+    def resident(self) -> bool:
+        return self._params is not None
+
     def release(self) -> None:
         """Drop every device buffer (stacked params + attached split)
         — same hygiene contract as release_device_state above."""
+        if self._batcher is not None:
+            self._batcher.close()
+            self._batcher = None
         self._params = None
         self._dataset = None
         self._labels = None
@@ -1062,19 +1160,9 @@ class EnsembleEvalEngine:
         self._predict_resident = self._score_resident = None
 
 
-def _stack_member_params(forwards, member_params, device):
-    """{fwd_name: {pname: (n_members, ...)}} — every member's f32
-    params stacked along a leading MEMBER axis and uploaded once.
-    Shared by the vmapped engines: EnsembleEvalEngine stacks N distinct
-    trained members; PopulationTrainEngine stacks P copies of one init
-    (same-signature genomes share the weight-init draw by seed)."""
-    return {
-        f.name: {
-            pn: device.put(np.stack(
-                [np.asarray(m[f.name][pn], np.float32)
-                 for m in member_params]))
-            for pn in member_params[0][f.name]}
-        for f in forwards}
+#: back-compat alias — the stacking helper moved to the shared
+#: fixed-shape machinery module (ops/batching.py)
+_stack_member_params = batching.stack_member_params
 
 
 class PopulationTrainEngine:
@@ -1157,7 +1245,7 @@ class PopulationTrainEngine:
         host = {f.name: {pn: np.asarray(v.map_read(), np.float32)
                          for pn, v in f.param_vectors().items()}
                 for f in self.forwards}
-        self._params = _stack_member_params(
+        self._params = batching.stack_member_params(
             self.forwards, [host] * self.n_members, device)
         self._opt = {}
         for gd in self.gds:
@@ -1177,11 +1265,8 @@ class PopulationTrainEngine:
     # -- trace construction -------------------------------------------
 
     def _resolved_dtype(self):
-        import jax.numpy as jnp
-        cd = self.compute_dtype
-        if cd is None:
-            cd = self.device.compute_dtype
-        return jnp.dtype(cd) if cd is not None else jnp.float32
+        return batching.resolve_compute_dtype(self.compute_dtype,
+                                              self.device)
 
     def _build(self) -> None:
         import jax
@@ -1207,12 +1292,7 @@ class PopulationTrainEngine:
                 return x
             return x.astype(jnp.float32) * q_scale + q_bias
 
-        def cast(tree):
-            if not mixed:
-                return tree
-            return jax.tree_util.tree_map(
-                lambda a: a.astype(cd) if a.dtype == jnp.float32 else a,
-                tree)
+        cast = batching.make_caster(cd)
 
         def forward_pass(params, x, rng_counter, train: bool):
             # identical key chain to FusedStepRunner: cohort members
@@ -1447,14 +1527,5 @@ class PopulationTrainEngine:
         self._train_step = self._eval_step = None
 
 
-def _pad_chunk(xb: np.ndarray, lb: np.ndarray, chunk: int):
-    """Fixed-shape chunk + validity mask: the scoring jit compiles
-    exactly once; padded rows carry mask 0 and cannot score."""
-    mask = np.ones(chunk, np.float32)
-    if len(xb) < chunk:
-        pad = chunk - len(xb)
-        mask[len(xb):] = 0.0
-        xb = np.concatenate(
-            [xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
-        lb = np.concatenate([lb, np.zeros(pad, lb.dtype)])
-    return xb, lb, mask
+#: back-compat alias — the chunk/pad helper moved to ops/batching.py
+_pad_chunk = batching.pad_chunk

@@ -1,0 +1,253 @@
+"""The dynamic micro-batching loop.
+
+One request is one or more sample rows; one DISPATCH is one
+fixed-shape mask-padded chunk of ``max_batch`` rows.  The batcher sits
+between them: concurrent ``submit()`` calls append rows to a queue,
+and a dedicated flush thread dispatches a chunk as soon as either
+
+- ``max_batch`` rows have coalesced (throughput bound), or
+- the OLDEST queued request has waited ``max_wait_s`` (latency bound —
+  a lone request never waits longer than the knob).
+
+Every dispatch has the SAME array shape (short batches are zero-padded
+and the padding discarded host-side), so the engine's jitted dispatch
+compiles exactly once — the zero-steady-state-recompile property the
+serving bench pins.  Requests larger than ``max_batch`` are split
+across consecutive dispatches and their Future resolves when the last
+slice lands.
+
+Telemetry (registry names in veles_tpu/events.py): per-request latency
+histogram (``serve.request_seconds``), queue-depth gauge, batch-size
+histogram (``serve.batch_rows`` — its max > 1 IS the proof requests
+coalesced), padded-slot counters for the batch-efficiency ratio, and a
+queue-wait histogram (the cost of the coalescing window).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from veles_tpu import events, telemetry
+from veles_tpu.ops import batching
+
+
+class _Pending:
+    """One submitted request: its rows, result slots, and Future."""
+
+    __slots__ = ("rows", "future", "t0", "results", "taken", "popped")
+
+    def __init__(self, rows: np.ndarray) -> None:
+        self.rows = rows
+        self.future: Future = Future()
+        self.t0 = time.perf_counter()
+        #: result slices in submission order (multi-dispatch requests)
+        self.results: List[np.ndarray] = []
+        #: rows already handed to a dispatch
+        self.taken = 0
+        #: fully taken off the queue (counts toward _inflight)
+        self.popped = False
+
+
+class MicroBatcher:
+    """Coalesce concurrent row requests into fixed-shape dispatches.
+
+    ``dispatch(xb) -> np.ndarray`` is the flush callback: it receives
+    the padded ``(max_batch, *sample_shape)`` array and returns the
+    per-row outputs at the same leading shape (the batcher slices the
+    valid rows back out and scatters them to the right Futures).
+    """
+
+    def __init__(self, dispatch: Callable[[np.ndarray], np.ndarray],
+                 max_batch: int, max_wait_s: float,
+                 label: str = "serve",
+                 sample_shape: Optional[Tuple[int, ...]] = None
+                 ) -> None:
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.dispatch = dispatch
+        self.max_batch = int(max_batch)
+        self.max_wait_s = max(0.0, float(max_wait_s))
+        self.label = label
+        self._cond = threading.Condition()
+        self._queue: "deque[_Pending]" = deque()
+        #: authoritative per-sample shape when the model declares one;
+        #: otherwise pinned by the first request
+        self._sample_shape = tuple(sample_shape) if sample_shape \
+            else None
+        self._queued_rows = 0
+        self._inflight = 0          # requests taken but not resolved
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"hive-batcher-{label}")
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------
+
+    def submit(self, rows: Any) -> Future:
+        """Enqueue one request of ``rows`` (one or more samples);
+        returns a Future resolving to the per-row outputs in request
+        order.  Thread-safe; never blocks on the device."""
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim == 0 or len(rows) == 0:
+            raise ValueError("a request needs at least one sample row")
+        p = _Pending(rows)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError(f"batcher {self.label!r} is closed")
+            # requests coalesce by CONCATENATION: a mismatched sample
+            # shape must bounce here (one error response), never reach
+            # the flush thread where it would poison a whole batch
+            shape = tuple(rows.shape[1:])
+            if self._sample_shape is None:
+                self._sample_shape = shape
+            elif shape != self._sample_shape:
+                raise ValueError(
+                    f"request rows have sample shape {shape}, but "
+                    f"{self.label!r} serves {self._sample_shape}")
+            self._queue.append(p)
+            self._queued_rows += len(rows)
+            telemetry.gauge(events.GAUGE_SERVE_QUEUE_DEPTH).set(
+                self._queued_rows)
+            self._cond.notify_all()
+        return p.future
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until the queue is empty and every taken request has
+        resolved — the graceful-stop path dispatches everything that
+        was accepted before the drain began.  False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._queue or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.1))
+        return True
+
+    def close(self) -> None:
+        """Refuse new submissions, drain what was accepted, and stop
+        the flush thread."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self.drain()
+        self._thread.join(timeout=5.0)
+
+    # -- flush loop ----------------------------------------------------
+
+    def _take_batch(self) -> Optional[List[Tuple[_Pending, int, int]]]:
+        """Wait for a flushable batch; returns [(request, start_row,
+        n_rows)] covering up to ``max_batch`` rows, or None when closed
+        and empty.  Flush condition: max_batch rows queued, or the
+        oldest request older than max_wait_s."""
+        with self._cond:
+            while True:
+                if self._queue:
+                    oldest = self._queue[0].t0
+                    if self._queued_rows >= self.max_batch:
+                        break
+                    wait_left = self.max_wait_s - \
+                        (time.perf_counter() - oldest)
+                    if wait_left <= 0:
+                        break
+                    self._cond.wait(min(wait_left, 0.05))
+                elif self._closed:
+                    return None
+                else:
+                    self._cond.wait(0.05)
+            take: List[Tuple[_Pending, int, int]] = []
+            room = self.max_batch
+            while room > 0 and self._queue:
+                p = self._queue[0]
+                rem = len(p.rows) - p.taken
+                if rem > room and take:
+                    # whole requests coalesce; only a request that is
+                    # ALONE bigger than max_batch ever splits (its
+                    # slices lead consecutive dispatches)
+                    break
+                n = min(room, rem)
+                take.append((p, p.taken, n))
+                p.taken += n
+                room -= n
+                self._queued_rows -= n
+                if p.taken >= len(p.rows):
+                    self._queue.popleft()
+                    p.popped = True
+                    self._inflight += 1
+            telemetry.gauge(events.GAUGE_SERVE_QUEUE_DEPTH).set(
+                self._queued_rows)
+            return take
+
+    def _loop(self) -> None:
+        while True:
+            take = self._take_batch()
+            if take is None:
+                return
+            rows = np.concatenate([p.rows[s:s + n]
+                                   for p, s, n in take])
+            n_valid = len(rows)
+            xb, _mask = batching.pad_rows(rows, self.max_batch)
+            t_wait = time.perf_counter()
+            for p, s, n in take:
+                if s == 0:
+                    telemetry.histogram(
+                        events.HIST_SERVE_WAIT_SECONDS).record(
+                        t_wait - p.t0)
+            try:
+                out = self.dispatch(xb)
+            except BaseException as e:  # noqa: BLE001 — a failed
+                # dispatch fails exactly the requests it carried; the
+                # loop (and the other queued requests) live on
+                telemetry.counter(
+                    events.CTR_SERVE_REQUEST_ERRORS).inc(len(take))
+                self._resolve(take, None, err=e)
+                continue
+            telemetry.counter(events.CTR_SERVE_BATCHES).inc()
+            telemetry.counter(events.CTR_SERVE_ROWS).inc(n_valid)
+            telemetry.counter(events.CTR_SERVE_BATCH_SLOTS).inc(
+                self.max_batch)
+            telemetry.histogram(events.HIST_SERVE_BATCH_ROWS).record(
+                n_valid)
+            self._resolve(take, np.asarray(out))
+
+    def _resolve(self, take, out, err=None) -> None:
+        off = 0
+        done: List[_Pending] = []
+        for p, s, n in take:
+            if err is None:
+                p.results.append(out[off:off + n])
+            off += n
+            if err is not None:
+                if not p.future.done():
+                    p.future.set_exception(err)
+                done.append(p)
+            elif s + n >= len(p.rows):   # request fully covered
+                if not p.future.done():  # a prior slice may have erred
+                    p.future.set_result(np.concatenate(p.results)
+                                        if len(p.results) > 1
+                                        else p.results[0])
+                done.append(p)
+        now = time.perf_counter()
+        with self._cond:
+            for p in done:
+                telemetry.histogram(
+                    events.HIST_SERVE_REQUEST_SECONDS).record(
+                    now - p.t0)
+                if p.popped:
+                    self._inflight -= 1
+                elif self._queue and self._queue[0] is p:
+                    # an erred oversized request still parked at the
+                    # head: retire it so its tail never dispatches
+                    self._queued_rows -= len(p.rows) - p.taken
+                    self._queue.popleft()
+                    p.popped = True
+            self._cond.notify_all()

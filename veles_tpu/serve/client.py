@@ -1,0 +1,179 @@
+"""Line-protocol client for the Hive serving process.
+
+Spawns (or attaches to) a ``--serve-models`` subprocess and multiplexes
+CONCURRENT callers over its stdin/stdout: every request draws a wire
+id under a lock, a single reader thread routes response lines back to
+per-id waiters, and heartbeats/garbage are tolerated as proof of life
+(the ChipEvaluatorPool discipline).  This is the surface the serving
+tests, bench.py's ``serve_*`` phases, and operator smoke probes share.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class HiveClient:
+    """Own one serving subprocess; thread-safe request fan-in."""
+
+    def __init__(self, models: Dict[str, str],
+                 backend: str = "cpu",
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 hbm_budget: Optional[int] = None,
+                 heartbeat_every: Optional[float] = None,
+                 metrics_dir: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 cwd: Optional[str] = None,
+                 start_timeout: float = 120.0) -> None:
+        cmd = [sys.executable, "-m", "veles_tpu", "--serve-models"]
+        cmd += [f"{name}={path}" for name, path in models.items()]
+        cmd += ["-b", backend]
+        if max_batch is not None:
+            cmd += ["--max-batch", str(max_batch)]
+        if max_wait_ms is not None:
+            cmd += ["--max-wait-ms", str(max_wait_ms)]
+        if hbm_budget is not None:
+            cmd += ["--hbm-budget", str(hbm_budget)]
+        if heartbeat_every is not None:
+            cmd += ["--heartbeat-every", str(heartbeat_every)]
+        if metrics_dir is not None:
+            cmd += ["--metrics-dir", metrics_dir]
+        run_env = dict(os.environ)
+        run_env.setdefault("JAX_PLATFORMS", "cpu")
+        if env:
+            run_env.update(env)
+        self.proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, bufsize=1, env=run_env, cwd=cwd)
+        self._wlock = threading.Lock()
+        self._cond = threading.Condition()
+        self._results: Dict[int, Dict[str, Any]] = {}
+        self._next_id = 0
+        self._eof = False
+        self.hello: Optional[Dict[str, Any]] = None
+        self.heartbeats = 0
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True,
+                                        name="hive-client-reader")
+        self._reader.start()
+        deadline = time.monotonic() + start_timeout
+        with self._cond:
+            while self.hello is None and not self._eof:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(min(left, 0.5))
+        if self.hello is None:
+            rc = self.proc.poll()
+            self.close(kill=True)
+            raise RuntimeError(
+                f"hive did not come up (rc={rc})")
+
+    # -- wire ----------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        for line in self.proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue   # non-protocol noise is proof of life
+            with self._cond:
+                if msg.get("ready"):
+                    self.hello = msg
+                elif "hb" in msg:
+                    self.heartbeats += 1
+                elif msg.get("id") is not None:
+                    self._results[msg["id"]] = msg
+                self._cond.notify_all()
+        with self._cond:
+            self._eof = True
+            self._cond.notify_all()
+
+    def _send(self, obj: Dict[str, Any]) -> None:
+        with self._wlock:
+            self.proc.stdin.write(json.dumps(obj) + "\n")
+            self.proc.stdin.flush()
+
+    def _draw_id(self) -> int:
+        with self._wlock:
+            self._next_id += 1
+            return self._next_id
+
+    def _wait(self, jid: int, timeout: float) -> Dict[str, Any]:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while jid not in self._results:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"no response for request {jid} in {timeout}s")
+                if self._eof and jid not in self._results:
+                    raise RuntimeError(
+                        "hive closed the pipe before answering "
+                        f"request {jid}")
+                self._cond.wait(min(left, 0.5))
+            return self._results.pop(jid)
+
+    # -- API -----------------------------------------------------------
+
+    def submit(self, model: str, rows: Any) -> int:
+        """Fire one request without waiting; returns its wire id
+        (collect with :meth:`wait_for`).  The SIGTERM-drain test and
+        the sustained-QPS bench issue bursts through this."""
+        jid = self._draw_id()
+        self._send({"id": jid, "model": model,
+                    "rows": np.asarray(rows, np.float32).tolist()})
+        return jid
+
+    def wait_for(self, jid: int,
+                 timeout: float = 60.0) -> Dict[str, Any]:
+        return self._wait(jid, timeout)
+
+    def request(self, model: str, rows: Any,
+                timeout: float = 60.0) -> Dict[str, Any]:
+        """One round trip: returns the response dict ({"pred",
+        "probs"} or {"error"})."""
+        return self.wait_for(self.submit(model, rows), timeout)
+
+    def stats(self, timeout: float = 60.0) -> Dict[str, Any]:
+        """The serving process's live telemetry snapshot."""
+        jid = self._draw_id()
+        self._send({"op": "stats", "id": jid})
+        return self._wait(jid, timeout)["stats"]
+
+    def sigterm(self) -> None:
+        self.proc.send_signal(signal.SIGTERM)
+
+    def wait(self, timeout: float = 60.0) -> int:
+        return self.proc.wait(timeout=timeout)
+
+    def close(self, kill: bool = False) -> None:
+        if self.proc.poll() is None:
+            try:
+                if not kill:
+                    self._send({"op": "shutdown"})
+                    self.proc.wait(timeout=15)
+            except Exception:  # noqa: BLE001 — cleanup must not raise
+                pass
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+    def __enter__(self) -> "HiveClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

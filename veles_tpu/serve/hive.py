@@ -1,0 +1,379 @@
+"""The Hive serving process: one chip, many models, sustained QPS.
+
+``python -m veles_tpu --serve-models NAME=PKG.vpkg [NAME=PKG ...]``
+
+Topology is the proven chip-owning evaluator shape
+(genetics/worker.py ``--serve``): ONE persistent process acquires the
+device at startup, announces itself with a hello line, emits heartbeat
+lines from a daemon thread, and speaks JSON lines over stdin/stdout —
+so the pool-style supervision and the ``--supervise`` resume recipe
+both apply unchanged.  What is new is what the process does between
+lines:
+
+- every model is a **Forge ensemble package** (``pack_ensemble`` —
+  manifest + workflow entry + members npz): install, rebuild the
+  config tree, build the template workflow ONCE, strip its training
+  state, and register the pure forward chain + host member params
+  with the residency manager;
+- requests (``{"id", "model", "rows"}``) route through the model's
+  ``EnsembleEvalEngine.submit()`` — the dynamic micro-batching loop
+  coalesces concurrent requests into ONE fixed-shape mask-padded
+  dispatch, so warm steady state has zero recompiles;
+- models stay HBM-resident under the residency budget; the LRU one
+  spills to host when a colder request set needs the space;
+- SIGTERM drains: in-flight and already-accepted requests finish,
+  telemetry flushes, and the process exits ``EXIT_PREEMPTED`` (14) so
+  ``--supervise`` restarts it with warm caches.
+
+Protocol lines (stdout; all writes serialized under one lock):
+
+    {"ready": true, "pid", "platform", "backend", "models": {...},
+     "max_batch", "max_wait_ms"}                      -- hello
+    {"hb": n, "pid"}                                  -- heartbeat
+    {"id", "model", "pred": [...], "probs": [[...]]}  -- response
+    {"id", "error": "..."}                            -- failed request
+    {"id", "stats": <telemetry snapshot>}             -- op=stats
+
+Requests (stdin): ``{"id", "model", "rows": [[...], ...]}``,
+``{"op": "stats", "id"}``, ``{"op": "shutdown"}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import queue
+import signal
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from veles_tpu import events, knobs, telemetry
+from veles_tpu.supervisor import EXIT_PREEMPTED
+
+
+class _FL:
+    """The launcher stand-in ``create_workflow`` expects."""
+    workflow = None
+
+
+def _strip_training_state(w) -> None:
+    """Free every device buffer the template workflow's initialize
+    uploaded that serving will never touch: the resident dataset and
+    the training-side param/optimizer state (the engine re-uploads
+    member params stacked).  A multi-model process cannot afford one
+    training run's HBM per model."""
+    fused = getattr(w, "fused", None)
+    if fused is not None and hasattr(fused, "release_device_state"):
+        fused.release_device_state()
+    ld = getattr(w, "loader", None)
+    for vec_name in ("original_data", "original_labels",
+                     "original_targets"):
+        vec = getattr(ld, vec_name, None)
+        if vec is not None and hasattr(vec, "reset"):
+            vec.reset()
+
+
+def load_model_package(name: str, pkg_path: str, device,
+                       install_dir: str, pristine: Dict[str, Any]):
+    """One Forge ensemble package -> a registered-ready HostedModel.
+
+    Installs (checksum-verified), rebuilds the global config tree from
+    ``pristine`` + the package's config files (per-model isolation, the
+    worker.py idiom), builds + initializes the template workflow on the
+    shared device, strips its training state, and pairs the pure
+    forward chain with the npz members."""
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    from veles_tpu.ensemble.packaging import load_members
+    from veles_tpu.forge import ForgePackage
+    from veles_tpu.launcher import apply_config_file, \
+        load_workflow_module
+    from veles_tpu.serve.residency import HostedModel
+
+    manifest = ForgePackage.install(pkg_path, install_dir)
+    pkg_root = manifest["root"]
+    snap = manifest.get("snapshot")
+    if not snap or not snap.endswith(".npz"):
+        raise ValueError(
+            f"{pkg_path}: serving needs an ENSEMBLE package (members "
+            f"npz snapshot via ensemble.packaging.pack_ensemble); this "
+            f"one carries {snap!r}")
+    members = load_members(os.path.join(pkg_root, snap))
+
+    root.__dict__.clear()
+    root.__dict__.update(copy.deepcopy(pristine))
+    for cf in manifest.get("configs", []):
+        apply_config_file(os.path.join(pkg_root, cf))
+    prng.seed_all(int(members[0].get("seed", 1234)))
+    mod = load_workflow_module(os.path.join(pkg_root,
+                                            manifest["entry"]))
+    create = getattr(mod, "create_workflow", None)
+    if create is None:
+        raise ValueError(
+            f"{pkg_path}: entry {manifest['entry']!r} exposes no "
+            f"create_workflow(launcher)")
+    w = create(_FL())
+    w.initialize(device=device)
+    try:
+        sample_shape = tuple(w.loader.original_data.shape[1:])
+    except (AttributeError, RuntimeError):
+        sample_shape = None   # streaming loaders: first request pins
+    _strip_training_state(w)
+    return HostedModel(
+        name, w.forwards, [m["params"] for m in members],
+        meta={"workflow": w, "version": manifest.get("version"),
+              "package": os.path.basename(pkg_path)},
+        sample_shape=sample_shape)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="veles_tpu --serve-models",
+        description="Hive: device-resident multi-model serving with "
+                    "dynamic micro-batching")
+    p.add_argument("models", nargs="+", metavar="NAME=PKG",
+                   help="model name = Forge ensemble package path "
+                        "(.vpkg from ensemble.packaging.pack_ensemble)")
+    p.add_argument("-b", "--backend", default="auto")
+    p.add_argument("--max-batch", type=int,
+                   default=int(knobs.get(knobs.SERVE_MAX_BATCH)),
+                   help="rows per micro-batch — the ONE fixed dispatch "
+                        "shape ($VELES_SERVE_MAX_BATCH)")
+    p.add_argument("--max-wait-ms", type=float,
+                   default=float(knobs.get(knobs.SERVE_MAX_WAIT_MS)),
+                   help="longest a queued request waits for "
+                        "co-batchable traffic ($VELES_SERVE_MAX_WAIT_MS)")
+    p.add_argument("--hbm-budget", type=int, default=0,
+                   help="residency budget override in bytes (default: "
+                        "device bytes_limit/2 or "
+                        "$VELES_SERVE_HBM_BUDGET)")
+    p.add_argument("--heartbeat-every", type=float,
+                   default=float(knobs.get(knobs.HEARTBEAT_EVERY)),
+                   help="seconds between heartbeat lines "
+                        "($VELES_HEARTBEAT_EVERY; 0 disables)")
+    p.add_argument("--install-dir", default=None,
+                   help="package install/staging directory (default: "
+                        "a temp dir)")
+    p.add_argument("--metrics-dir", default=None,
+                   help="arm Sightline persistence (also "
+                        "$VELES_METRICS_DIR)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from veles_tpu.backends import make_device
+    from veles_tpu.config import root
+    from veles_tpu.logger import setup_logging
+    from veles_tpu.serve.residency import ResidencyManager
+
+    args = build_parser().parse_args(argv)
+    setup_logging(10 if args.verbose else 20)
+    if args.metrics_dir:
+        telemetry.configure(args.metrics_dir)
+    install_dir = args.install_dir
+    if install_dir is None:
+        import tempfile
+        install_dir = tempfile.mkdtemp(prefix="hive_models_")
+
+    specs: List[tuple] = []
+    for spec in args.models:
+        name, _, path = spec.partition("=")
+        if not name or not path:
+            print(f"--serve-models: bad model spec {spec!r} "
+                  f"(want NAME=PACKAGE.vpkg)", file=sys.stderr)
+            return 2
+        if not os.path.isfile(path):
+            print(f"--serve-models: no such package {path!r}",
+                  file=sys.stderr)
+            return 2
+        specs.append((name, path))
+
+    device = make_device(args.backend)
+    platform = getattr(device, "platform", device.backend_name)
+    if not getattr(device, "is_jax", False):
+        print("--serve-models needs a jax device (TPU or XLA:CPU); "
+              "-b numpy has no vmapped serving engine",
+              file=sys.stderr)
+        return 2
+    residency = ResidencyManager(
+        device, budget_bytes=args.hbm_budget or None,
+        max_batch=max(1, args.max_batch),
+        max_wait_s=max(0.0, args.max_wait_ms) / 1000.0)
+
+    pristine = copy.deepcopy(dict(root.__dict__))
+    for name, path in specs:
+        model = load_model_package(name, path, device, install_dir,
+                                   pristine)
+        residency.register(model)
+        # admit eagerly in CLI order: the budget may spill the colder
+        # ones right back — that IS the steady-state policy at work
+        residency.ensure(name)
+
+    emit_lock = threading.Lock()
+
+    def emit(obj: Dict[str, Any]) -> None:
+        with emit_lock:
+            print(json.dumps(obj), flush=True)
+
+    hello = {
+        "ready": True, "pid": os.getpid(),
+        "backend": device.backend_name, "platform": platform,
+        "max_batch": residency.max_batch,
+        "max_wait_ms": residency.max_wait_s * 1000.0,
+        "models": {
+            m.name: {"members": len(m.member_params),
+                     "param_bytes": m.param_bytes,
+                     "resident": m.resident,
+                     "version": m.meta.get("version")}
+            for m in residency.models.values()},
+    }
+    telemetry.event(events.EV_SERVE_READY, pid=os.getpid(),
+                    platform=platform,
+                    models=sorted(residency.models),
+                    max_batch=residency.max_batch)
+    emit(hello)
+    telemetry.flush()
+
+    stop = {"signal": None}
+    stop_event = threading.Event()
+
+    def _on_term(signum, frame) -> None:
+        # flag only — the main loop owns the drain; a second signal
+        # exits immediately (the operator insists)
+        if stop["signal"] is not None:
+            os.write(2, b"hive: second signal - hard exit\n")
+            os._exit(EXIT_PREEMPTED)
+        stop["signal"] = signum
+        stop_event.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGINT, _on_term)
+    except (ValueError, OSError):   # embedded / non-main thread
+        pass
+
+    hb_stop = threading.Event()
+
+    def _hb_loop() -> None:
+        n = 0
+        while not hb_stop.wait(args.heartbeat_every):
+            emit({"hb": n, "pid": os.getpid()})
+            n += 1
+
+    if args.heartbeat_every > 0:
+        threading.Thread(target=_hb_loop, daemon=True,
+                         name="hive-heartbeat").start()
+
+    jobs: "queue.Queue[Optional[str]]" = queue.Queue()
+
+    def _read_stdin() -> None:
+        for line in sys.stdin:
+            jobs.put(line)
+        jobs.put(None)   # EOF
+
+    threading.Thread(target=_read_stdin, daemon=True,
+                     name="hive-stdin").start()
+
+    def handle(line: str) -> bool:
+        """One request line; returns False when the loop should end."""
+        line = line.strip()
+        if not line:
+            return True
+        try:
+            job = json.loads(line)
+        except ValueError:
+            emit({"error": f"bad request line: {line[:120]!r}"})
+            return True
+        op = job.get("op")
+        if op == "shutdown":
+            return False
+        if op == "stats":
+            emit({"id": job.get("id"), "stats": telemetry.snapshot()})
+            return True
+        jid = job.get("id")
+        telemetry.counter(events.CTR_SERVE_REQUESTS).inc()
+        try:
+            model = job["model"]
+            rows = np.asarray(job["rows"], np.float32)
+            engine = residency.ensure(model)
+            fut = engine.submit(rows)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — a bad request
+            # answers with an error; the process serves on
+            telemetry.counter(events.CTR_SERVE_REQUEST_ERRORS).inc()
+            emit({"id": jid, "error": f"{type(e).__name__}: {e}"})
+            return True
+
+        def _deliver(f, jid=jid, model=model) -> None:
+            try:
+                probs = f.result()
+            except BaseException as e:  # noqa: BLE001 — dispatch-side
+                emit({"id": jid, "error": f"{type(e).__name__}: {e}"})
+                return
+            emit({"id": jid, "model": model,
+                  "pred": np.argmax(probs, axis=-1).tolist(),
+                  "probs": probs.tolist()})
+
+        fut.add_done_callback(_deliver)
+        return True
+
+    rc = 0
+    while not stop_event.is_set():
+        try:
+            line = jobs.get(timeout=0.2)
+        except queue.Empty:
+            continue
+        if line is None:      # stdin closed: the parent went away
+            break
+        if not handle(line):
+            break
+
+    # -- drain ---------------------------------------------------------
+    # accept everything already on the wire (the stdin thread keeps
+    # pulling bytes the clients flushed before the signal), then let
+    # every model's batcher finish its queue
+    if stop_event.is_set():
+        stop_event.wait(0.0)
+        import time as _time
+        _time.sleep(0.3)
+    n_late = 0
+    while True:
+        try:
+            line = jobs.get_nowait()
+        except queue.Empty:
+            break
+        if line is None:
+            continue
+        n_late += 1
+        handle(line)
+    drained = residency.drain_all()
+    telemetry.event(events.EV_SERVE_DRAIN, late_requests=n_late,
+                    complete=bool(drained))
+    reason = None
+    if stop["signal"] is not None:
+        try:
+            reason = signal.Signals(stop["signal"]).name
+        except ValueError:
+            reason = f"sig{stop['signal']}"
+        rc = EXIT_PREEMPTED
+    telemetry.event(events.EV_SERVE_SHUTDOWN, reason=reason, code=rc)
+    hb_stop.set()
+    telemetry.flush()
+    if rc:
+        # mirror the Phoenix preemption contract: flush everything and
+        # exit 14 so --supervise resumes the serving process
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

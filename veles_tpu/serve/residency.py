@@ -1,0 +1,188 @@
+"""Multi-model HBM residency: budget accounting + LRU spill-to-host.
+
+Serving keeps every model's stacked f32 member params resident so a
+request costs one dispatch and zero uploads — but HBM is finite and
+the fleet of models is not.  This manager extends the residency-budget
+idea of the uint8 ingest work (loader/quantize.py shrank DATASET
+residency 4x against ``$VELES_MAX_RESIDENT_BYTES``) to MODELS: each
+model's device cost is known exactly before upload
+(``batching.stacked_param_bytes``), the budget is the device's
+reported ``bytes_limit`` or ``$VELES_SERVE_HBM_BUDGET``, and when
+admitting a model would overflow it, the least-recently-USED resident
+model spills — its engine keeps the compiled dispatchers and only
+drops the stacked params (the manager holds the immutable host
+copies), so a later restore pays one H2D upload, NOT a recompile.
+
+Every transition journals (``serve.model_loaded`` /
+``serve.model_spilled`` / ``serve.model_restored``) and the
+``serve.models_resident`` / ``serve.resident_bytes`` gauges track the
+live set.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from veles_tpu import events, knobs, telemetry
+from veles_tpu.logger import Logger
+
+
+class HostedModel:
+    """One servable model: the pure forward chain, the immutable host
+    member params, and (when resident) the vmapped engine."""
+
+    def __init__(self, name: str, forwards: List[Any],
+                 member_params: List[Dict[str, Dict[str, Any]]],
+                 meta: Optional[Dict[str, Any]] = None,
+                 sample_shape=None) -> None:
+        from veles_tpu.ops import batching
+        self.name = name
+        self.forwards = list(forwards)
+        self.member_params = member_params
+        self.meta = dict(meta or {})
+        #: per-sample input shape (from the template loader) — lets
+        #: the batcher bounce mis-shaped requests at submit time
+        self.sample_shape = tuple(sample_shape) if sample_shape \
+            else None
+        self.engine = None   # EnsembleEvalEngine once first admitted
+        self.param_bytes = batching.stacked_param_bytes(member_params)
+        self.last_used = 0.0
+
+    @property
+    def resident(self) -> bool:
+        return self.engine is not None and self.engine.resident
+
+
+class ResidencyManager(Logger):
+    """Admit models under the HBM budget; spill the LRU one over it."""
+
+    def __init__(self, device: Any,
+                 budget_bytes: Optional[int] = None,
+                 max_batch: int = 64,
+                 max_wait_s: float = 0.005) -> None:
+        self.device = device
+        self.budget_bytes = int(budget_bytes) if budget_bytes \
+            else self._device_budget(device)
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self.models: Dict[str, HostedModel] = {}
+
+    @staticmethod
+    def _device_budget(device: Any) -> int:
+        """The device's reported HBM limit, else the declared knob
+        default — the same accounting the GA cohort sizing uses."""
+        jdev = getattr(device, "jax_device", None)
+        if jdev is not None:
+            try:
+                limit = int((jdev.memory_stats() or {})
+                            .get("bytes_limit", 0))
+                if limit:
+                    # half held back for activations + micro-batches
+                    return limit // 2
+            except Exception:  # noqa: BLE001 — CPU backends report none
+                pass
+        return int(knobs.get(knobs.SERVE_HBM_BUDGET))
+
+    # -- registry ------------------------------------------------------
+
+    def register(self, model: HostedModel) -> None:
+        if model.name in self.models:
+            raise ValueError(f"duplicate model name {model.name!r}")
+        self.models[model.name] = model
+
+    def resident_bytes(self) -> int:
+        return sum(m.param_bytes for m in self.models.values()
+                   if m.resident)
+
+    def resident_count(self) -> int:
+        return sum(1 for m in self.models.values() if m.resident)
+
+    def _update_gauges(self) -> None:
+        telemetry.gauge(events.GAUGE_SERVE_MODELS_RESIDENT).set(
+            self.resident_count())
+        telemetry.gauge(events.GAUGE_SERVE_RESIDENT_BYTES).set(
+            self.resident_bytes())
+
+    # -- admission -----------------------------------------------------
+
+    def ensure(self, name: str):
+        """The serving entry point: return ``name``'s ready engine,
+        admitting (or restoring) it under the budget first.  Raises
+        KeyError for an unregistered name."""
+        m = self.models[name]
+        m.last_used = time.monotonic()
+        if m.resident:
+            return m.engine
+        self._make_room(m)
+        if m.engine is None:
+            from veles_tpu.ops.fused import EnsembleEvalEngine
+            t0 = time.perf_counter()
+            m.engine = EnsembleEvalEngine(m.forwards, m.member_params,
+                                          self.device)
+            m.engine.attach_batcher(self.max_batch, self.max_wait_s,
+                                    label=name,
+                                    sample_shape=m.sample_shape)
+            telemetry.event(events.EV_SERVE_MODEL_LOADED, model=name,
+                            members=m.engine.n_members,
+                            param_bytes=m.param_bytes,
+                            seconds=round(time.perf_counter() - t0, 4))
+            self.info("model %r loaded: %d members, %.2f MiB stacked",
+                      name, m.engine.n_members,
+                      m.param_bytes / (1 << 20))
+        else:
+            t0 = time.perf_counter()
+            m.engine.restore_params(m.member_params)
+            telemetry.event(events.EV_SERVE_MODEL_RESTORED, model=name,
+                            param_bytes=m.param_bytes,
+                            seconds=round(time.perf_counter() - t0, 4))
+            self.info("model %r restored from host spill (%.2f MiB)",
+                      name, m.param_bytes / (1 << 20))
+        self._update_gauges()
+        return m.engine
+
+    def _make_room(self, incoming: HostedModel) -> None:
+        """Spill least-recently-used resident models until ``incoming``
+        fits the budget.  A model that alone exceeds the budget is
+        admitted anyway (with a loud warning) — refusing it would make
+        the budget knob a denial-of-service on itself."""
+        need = incoming.param_bytes
+        if need > self.budget_bytes:
+            self.warning(
+                "model %r needs %d bytes, over the whole residency "
+                "budget (%d) — admitting alone; consider raising "
+                "$VELES_SERVE_HBM_BUDGET", incoming.name, need,
+                self.budget_bytes)
+        while self.resident_bytes() + need > self.budget_bytes:
+            victims = [m for m in self.models.values()
+                       if m.resident and m is not incoming]
+            if not victims:
+                break
+            lru = min(victims, key=lambda m: m.last_used)
+            self._spill(lru)
+
+    def _spill(self, m: HostedModel) -> None:
+        # outstanding requests first: the engine's queued micro-batches
+        # must dispatch while the params are still on device
+        m.engine.drain()
+        m.engine.spill_params()
+        telemetry.counter(events.CTR_SERVE_SPILLS).inc()
+        telemetry.event(events.EV_SERVE_MODEL_SPILLED, model=m.name,
+                        param_bytes=m.param_bytes)
+        self.info("model %r spilled to host (LRU, freeing %.2f MiB)",
+                  m.name, m.param_bytes / (1 << 20))
+        self._update_gauges()
+
+    def drain_all(self, timeout: float = 30.0) -> bool:
+        """Drain every model's batcher (the SIGTERM path)."""
+        ok = True
+        for m in self.models.values():
+            if m.engine is not None:
+                ok = m.engine.drain(timeout) and ok
+        return ok
+
+    def close(self) -> None:
+        for m in self.models.values():
+            if m.engine is not None:
+                m.engine.release()
+                m.engine = None

@@ -1,11 +1,16 @@
 """Master server: zmq master--slave data parallelism (DCN compat mode).
 
+**LEGACY surface.**  Kept for reference parity and heterogeneous
+clusters without an ICI/DCN mesh; it is NOT on the roadmap's serving
+or scaling paths.  Training-scale distribution is SPMD over the mesh
+(veles_tpu/parallel/, ``--dp``/``--multihost``); ONLINE INFERENCE is
+the Hive serving tier (veles_tpu/serve, ``--serve-models`` — see
+docs/guide.md "Online serving").
+
 Reference parity: veles/server.py — the master owns canonical weights,
 serves minibatch jobs to slaves, aggregates their weight updates, and
 tolerates slaves joining/leaving mid-run (jobs of dead slaves are
-requeued; SURVEY.md §4.2).  The primary TPU distribution mode is SPMD
-over ICI (veles_tpu/parallel/) — this path exists for heterogeneous
-clusters where chips share no ICI/DCN mesh.
+requeued; SURVEY.md §4.2).
 
 Protocol (pickle over zmq REQ/ROUTER):
 

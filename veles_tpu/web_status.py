@@ -1,14 +1,29 @@
 """Web status dashboard.
 
-Reference parity: veles/web_status.py — a web server showing all
-running workflows; each run POSTs periodic status updates (SURVEY.md
-§3.1 "Web status").  Rebuilt on the stdlib http.server (no Tornado in
-this environment): GET / renders an auto-refreshing dashboard, GET
-/api/status returns JSON, POST /api/update ingests a workflow's status.
+Two feeds:
 
-Standalone:   python -m veles_tpu.web_status [port]
+- **Sightline mode (primary)** — ``python -m veles_tpu.web_status
+  --metrics-dir DIR [port]`` renders the LIVE telemetry state of
+  whatever runs in that metrics dir (training, GA, the Hive serving
+  tier): counters, gauges, per-histogram p50/p90/p99 latency tables,
+  derived throughput, and the journal timeline, re-read on every
+  refresh through the same ``veles_tpu/obs.py`` internals
+  ``scripts/obs_report.py`` uses.  ``GET /api/metrics`` returns the
+  merged snapshot as JSON.  Point it at a serving process's
+  ``--metrics-dir`` and the dashboard IS the serving console.
+
+- **Legacy push feed** — the original reference-parity surface
+  (veles/web_status.py: each run POSTs per-epoch status updates;
+  SURVEY.md §3.1 "Web status").  Kept for ``--status-server`` CLI
+  compatibility: GET / (without a metrics dir) renders the run table,
+  GET /api/status returns JSON, POST /api/update ingests.  New
+  tooling should prefer the Sightline feed — it needs no per-workflow
+  reporter unit and covers every subsystem that emits telemetry.
+
+Standalone:   python -m veles_tpu.web_status [port] [--metrics-dir D]
 In training:  --status-server http://host:port on the CLI attaches a
-              StatusReporter unit that POSTs after every epoch.
+              StatusReporter unit that POSTs after every epoch
+              (legacy feed).
 """
 
 from __future__ import annotations
@@ -57,8 +72,22 @@ class StatusStore:
             return {k: dict(v) for k, v in self._runs.items()}
 
 
+_METRICS_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu telemetry</title>
+<meta http-equiv="refresh" content="2">
+<style>
+ body {{ font-family: monospace; background: #111; color: #ddd; }}
+ pre {{ font-size: 13px; line-height: 1.35; }}
+ h2 {{ color: #9c6; }}
+</style></head>
+<body><h2>veles_tpu — live telemetry ({mdir})</h2>
+<pre>{report}</pre></body></html>
+"""
+
+
 class _Handler(BaseHTTPRequestHandler):
     store: StatusStore = None  # type: ignore  # set by server
+    metrics_dir: Optional[str] = None  # set by server
 
     def log_message(self, fmt, *args):  # silence per-request stderr
         pass
@@ -71,9 +100,36 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_metrics_json(self) -> None:
+        from veles_tpu.obs import load_dir
+        reg, snaps, journals, events = load_dir(self.metrics_dir)
+        merged = reg.snapshot()
+        merged["snapshots"] = len(snaps)
+        merged["journal_events"] = len(events)
+        self._send(200, json.dumps(merged).encode(),
+                   "application/json")
+
+    def _send_metrics_page(self) -> None:
+        import html
+
+        from veles_tpu.obs import load_dir, render
+        reg, snaps, journals, events = load_dir(self.metrics_dir)
+        report = render(self.metrics_dir, reg, snaps, journals,
+                        events)
+        self._send(200, _METRICS_PAGE.format(
+            mdir=html.escape(self.metrics_dir),
+            report=html.escape(report)).encode())
+
     def do_GET(self) -> None:
         import html
 
+        if self.metrics_dir and self.path.startswith("/api/metrics"):
+            return self._send_metrics_json()
+        if self.metrics_dir and not self.path.startswith("/api/") \
+                and not self.path.startswith("/runs"):
+            # Sightline mode owns the dashboard; the legacy push-feed
+            # table stays reachable at /runs for mixed deployments
+            return self._send_metrics_page()
         runs = self.store.snapshot()
         if self.path.startswith("/api/status"):
             self._send(200, json.dumps(runs).encode(),
@@ -116,14 +172,20 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class WebStatusServer(Logger):
-    def __init__(self, port: int = 8090, host: str = "0.0.0.0") -> None:
+    def __init__(self, port: int = 8090, host: str = "0.0.0.0",
+                 metrics_dir: Optional[str] = None) -> None:
         self.store = StatusStore()
-        handler = type("Handler", (_Handler,), {"store": self.store})
+        self.metrics_dir = metrics_dir
+        handler = type("Handler", (_Handler,),
+                       {"store": self.store,
+                        "metrics_dir": metrics_dir})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
 
     def serve_forever(self) -> None:
-        self.info("web status on http://0.0.0.0:%d", self.port)
+        self.info("web status on http://0.0.0.0:%d%s", self.port,
+                  f" (telemetry dir {self.metrics_dir})"
+                  if self.metrics_dir else "")
         self.httpd.serve_forever()
 
     def start_background(self) -> threading.Thread:
@@ -177,14 +239,23 @@ class StatusReporter(Plotter):
                 self.warning("status POST failed: %s", e)
 
 
-def main() -> int:
-    import sys
+def main(argv=None) -> int:
+    import argparse
 
     from veles_tpu.logger import setup_logging
 
     setup_logging()
-    port = int(sys.argv[1]) if len(sys.argv) > 1 else 8090
-    WebStatusServer(port=port).serve_forever()
+    p = argparse.ArgumentParser(prog="veles_tpu.web_status")
+    p.add_argument("port", nargs="?", type=int, default=8090)
+    p.add_argument("--metrics-dir", default=None,
+                   help="render LIVE Sightline telemetry from this "
+                        "metrics dir (the obs_report view, "
+                        "auto-refreshing) instead of the legacy "
+                        "push feed")
+    p.add_argument("--host", default="0.0.0.0")
+    args = p.parse_args(argv)
+    WebStatusServer(port=args.port, host=args.host,
+                    metrics_dir=args.metrics_dir).serve_forever()
     return 0
 
 
