@@ -864,6 +864,379 @@ def serve_metric(phase):
         return None
 
 
+def fleet_metric(phase):
+    """Swarm fleet serving (ISSUE 11 acceptance): sustained QPS vs
+    replica count (1/2/4 replicas over the SAME model set, XLA:CPU),
+    plus a spike test that saturates one replica's capacity and a
+    SIGKILL failover mid-load.
+
+    Sizing note (the one-core build box): a single hive in the bench
+    regime is WINDOW-bound, not CPU-bound — with C closed-loop
+    clients < max_batch, every dispatch waits the full max-wait
+    window while the core idles (docs/perf.md round-6: "max_wait is a
+    latency floor"), so replicas genuinely multiply throughput by
+    firing their windows concurrently until the core saturates.  On a
+    many-core host the same harness measures the CPU-parallel
+    speedup; on a TPU mesh, one replica per chip.
+
+    The spike drives far more closed-loop clients than the fleet's
+    measured capacity with the SLO knob armed: admitted p99 must hold
+    <= the SLO (set at BENCH_FLEET_SLO_MULT x the unloaded p99) while
+    explicit `overloaded` sheds — never timeouts — absorb the
+    overflow.  Mid-spike the canary split keeps flowing; a separate
+    moderate-load window SIGKILLs one replica and counts lost
+    requests (bar: zero — in-flight requests retry once on the
+    peer)."""
+    if os.environ.get("BENCH_SKIP_FLEET"):
+        return None
+    import tempfile
+    import textwrap
+    import threading
+
+    replica_counts = [
+        int(x) for x in os.environ.get(
+            "BENCH_FLEET_REPLICAS", "1,2,4").split(",")]
+    clients_per = int(os.environ.get(
+        "BENCH_FLEET_CLIENTS_PER_REPLICA", "6"))
+    window = float(os.environ.get("BENCH_FLEET_WINDOW_SEC", "3"))
+    max_batch = int(os.environ.get("BENCH_FLEET_MAX_BATCH", "16"))
+    max_wait_ms = float(os.environ.get(
+        "BENCH_FLEET_MAX_WAIT_MS", "8"))
+    members = int(os.environ.get("BENCH_FLEET_MEMBERS", "2"))
+    hidden = int(os.environ.get("BENCH_FLEET_HIDDEN", "128"))
+    spike_clients = int(os.environ.get(
+        "BENCH_FLEET_SPIKE_CLIENTS", "96"))
+    slo_mult = float(os.environ.get("BENCH_FLEET_SLO_MULT", "1.7"))
+    canary_fraction = float(os.environ.get(
+        "BENCH_FLEET_CANARY_FRACTION", "0.2"))
+    try:
+        from veles_tpu import events, prng, telemetry
+        from veles_tpu.backends import NumpyDevice
+        from veles_tpu.ensemble.packaging import pack_ensemble
+        from veles_tpu.launcher import load_workflow_module
+        from veles_tpu.serve.router import FleetRouter
+
+        def model_ctr(model, what):
+            # the fleet.model.<name>.* dynamic family (events.py)
+            return f"fleet.model.{model}.{what}"
+
+        tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+        wf = os.path.join(tmp, "wf.py")
+        with open(wf, "w") as f:
+            f.write(textwrap.dedent(f"""
+                from veles_tpu import prng
+                from veles_tpu.datasets import synthetic_classification
+                from veles_tpu.loader import ArrayLoader
+                from veles_tpu.ops.standard_workflow import \\
+                    StandardWorkflow
+
+                def create_workflow(launcher):
+                    prng.seed_all(7171)
+                    train, valid, _ = synthetic_classification(
+                        64, 16, (8, 8, 1), n_classes=10, seed=4)
+                    return StandardWorkflow(
+                        loader_factory=lambda w: ArrayLoader(
+                            w, train=train, valid=valid,
+                            minibatch_size=16, name="loader"),
+                        layers=[
+                            {{"type": "all2all_tanh",
+                              "->": {{"output_sample_shape": {hidden}}},
+                              "<-": {{"learning_rate": 0.1}}}},
+                            {{"type": "softmax",
+                              "->": {{"output_sample_shape": 10}},
+                              "<-": {{"learning_rate": 0.1}}}},
+                        ],
+                        decision_config={{"max_epochs": 1}},
+                        name="fleet_bench_wf")
+            """))
+        mod = load_workflow_module(wf)
+
+        class _FL:
+            workflow = None
+
+        def build_members(seed):
+            prng.seed_all(seed)
+            w = mod.create_workflow(_FL())
+            w.initialize(device=NumpyDevice())
+            base = {fw.name: {k: np.asarray(v) for k, v in
+                              fw.gather_params().items()}
+                    for fw in w.forwards}
+            rng = np.random.default_rng(seed)
+            ms = [{"params": {fn: {pn: a + 0.02 * rng
+                                   .standard_normal(a.shape)
+                                   .astype(np.float32)
+                                   for pn, a in p.items()}
+                              for fn, p in base.items()},
+                   "valid_error": 0.0, "seed": seed, "values": None,
+                   "forward_names": [fw.name for fw in w.forwards]}
+                  for _ in range(members)]
+            return w, ms
+
+        phase(f"fleet: packing 2 ensemble packages ({members} "
+              f"members x {hidden} hidden)")
+        w_main, members_main = build_members(41)
+        _, members_shadow = build_members(42)
+        pkg_main = os.path.join(tmp, "primary.vpkg")
+        pkg_shadow = os.path.join(tmp, "shadow.vpkg")
+        pack_ensemble(pkg_main, "primary", members_main, wf)
+        pack_ensemble(pkg_shadow, "shadow", members_shadow, wf)
+        specs = {"primary": pkg_main, "shadow": pkg_shadow}
+        here = os.path.dirname(os.path.abspath(__file__))
+        row = np.random.default_rng(0).standard_normal(
+            (1, 8, 8, 1)).astype(np.float32)
+
+        def host_oracle(x):
+            acc = None
+            for m in members_main:
+                out = x
+                for fw in w_main.forwards:
+                    out, _ = fw.apply_fwd(
+                        {k: np.asarray(v)
+                         for k, v in m["params"][fw.name].items()},
+                        out, rng=None, train=False)
+                out = np.asarray(out)
+                acc = out if acc is None else acc + out
+            return acc / len(members_main)
+
+        def warm(router):
+            # warm EVERY replica directly (least-loaded routing sends
+            # all idle-fleet probes to replica 0): both models load,
+            # the one fixed dispatch shape compiles once per replica
+            for r in router.replicas:
+                r.client.request("primary", row, timeout=120)
+                r.client.request("shadow", row, timeout=120)
+                for _ in range(4):
+                    r.client.request("primary", row, timeout=120)
+
+        def replica_compiles(router):
+            out = []
+            for st in router.replica_stats():
+                out.append((st or {}).get("counters", {})
+                           .get("serve.compiles", 0))
+            return out
+
+        def closed_loop_window(router, n_clients, seconds,
+                               shed_backoff_s=0.005, timeout=60.0,
+                               ramp_s=0.0):
+            """n_clients closed-loop threads on 'primary'; returns
+            (ok_latencies, sheds, timeouts, errors).  ``ramp_s``
+            discards the leading transient (a spike's queues build —
+            and the admission EMAs catch up — within the ramp; the
+            quoted p99 is the steady overloaded state)."""
+            lat = []
+            sheds = [0]
+            timeouts = [0]
+            errors = [0]
+            start = time.perf_counter()
+            stop_at = start + seconds
+            measure_from = start + ramp_s
+
+            def loop(i):
+                r = np.random.default_rng(i)
+                x = r.standard_normal((1, 8, 8, 1)) \
+                    .astype(np.float32)
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    res = router.request("primary", x,
+                                         timeout=timeout)
+                    dt = time.perf_counter() - t0
+                    if res.get("overloaded"):
+                        if t0 >= measure_from:
+                            sheds[0] += 1
+                        time.sleep(shed_backoff_s)
+                    elif "error" in res:
+                        if "timeout" in res["error"]:
+                            timeouts[0] += 1
+                        else:
+                            errors[0] += 1
+                    elif t0 >= measure_from:
+                        lat.append(dt)
+
+            ts = [threading.Thread(target=loop, args=(i,))
+                  for i in range(n_clients)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return lat, sheds[0], timeouts[0], errors[0]
+
+        # -- the replica-count curve ----------------------------------
+        qps_by_n = {}
+        oracle_diff = None
+        recompiles_total = 0
+        for n in replica_counts:
+            phase(f"fleet: spawning {n} replica(s)")
+            router = FleetRouter(
+                specs, n_replicas=n, backend="cpu",
+                max_batch=max_batch, max_wait_ms=max_wait_ms,
+                metrics_dir=os.path.join(tmp, f"metrics-{n}"),
+                cwd=here)
+            try:
+                warm(router)
+                if oracle_diff is None:
+                    resp = router.request("primary", row, timeout=120)
+                    oracle_diff = float(np.abs(
+                        np.asarray(resp["probs"])
+                        - host_oracle(row)).max())
+                    assert oracle_diff < 1e-4, oracle_diff
+                compiles_before = replica_compiles(router)
+                clients = clients_per * n
+                phase(f"fleet: n={n} sustained window "
+                      f"({clients} clients, {window}s)")
+                lat, sheds, tmo, errs = closed_loop_window(
+                    router, clients, window)
+                qps = len(lat) / window
+                compiles_after = replica_compiles(router)
+                recompiles_total += sum(
+                    a - b for a, b in zip(compiles_after,
+                                          compiles_before))
+                qps_by_n[n] = qps
+                spread = router.routed_counts()
+                phase(f"fleet: n={n} -> {qps:.1f} qps "
+                      f"(spread {spread}, sheds {sheds}, "
+                      f"timeouts {tmo}, errors {errs})")
+            finally:
+                router.close()
+        n_lo, n_hi = min(qps_by_n), max(qps_by_n)
+        efficiency = qps_by_n[n_hi] / (
+            (n_hi / n_lo) * qps_by_n[n_lo])
+
+        # -- spike + canary + failover on one 2-replica fleet ---------
+        phase("fleet: spawning the 2-replica spike/canary fleet")
+        router = FleetRouter(
+            specs, n_replicas=2, backend="cpu",
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            canaries={"shadow": ("primary", canary_fraction)},
+            metrics_dir=os.path.join(tmp, "metrics-spike"),
+            cwd=here)
+        try:
+            warm(router)
+            phase("fleet: unloaded window (canary split active)")
+            req0 = telemetry.counter(
+                model_ctr("primary", "requests")).value
+            mir0 = telemetry.counter(
+                model_ctr("shadow", "mirrored")).value
+            lat, _, _, _ = closed_loop_window(
+                router, max(2, clients_per // 2), window)
+            unloaded_p50 = 1000 * float(np.percentile(lat, 50))
+            unloaded_p99 = 1000 * float(np.percentile(lat, 99))
+            d_req = telemetry.counter(
+                model_ctr("primary", "requests")).value - req0
+            d_mir = telemetry.counter(
+                model_ctr("shadow", "mirrored")).value - mir0
+            canary_observed = d_mir / d_req if d_req else None
+
+            slo = slo_mult * unloaded_p99
+            router.slo_p99_ms = slo
+            ramp = min(1.0, window / 3)
+            phase(f"fleet: spike window ({spike_clients} clients, "
+                  f"SLO {slo:.1f}ms armed, {ramp:.1f}s ramp)")
+            lat, sheds, tmo, errs = closed_loop_window(
+                router, spike_clients, window + ramp,
+                shed_backoff_s=0.02, ramp_s=ramp)
+            spike_qps = len(lat) / window
+            spike_p99 = 1000 * float(np.percentile(lat, 99)) \
+                if lat else None
+            shed_fraction = sheds / max(1, sheds + len(lat))
+            router.slo_p99_ms = 0.0
+            phase(f"fleet: spike -> {spike_qps:.1f} qps admitted, "
+                  f"p99 {spike_p99 and round(spike_p99, 1)}ms vs "
+                  f"unloaded {unloaded_p99:.1f}ms, {sheds} sheds, "
+                  f"{tmo} timeouts")
+
+            phase("fleet: SIGKILL one replica mid-load")
+            retries0 = telemetry.counter(
+                events.CTR_FLEET_RETRIES).value
+            lost = [0]
+            ok = [0]
+            stop_at = time.perf_counter() + window
+
+            def failover_loop(i):
+                r = np.random.default_rng(1000 + i)
+                x = r.standard_normal((1, 8, 8, 1)) \
+                    .astype(np.float32)
+                while time.perf_counter() < stop_at:
+                    res = router.request("primary", x, timeout=60)
+                    if "error" in res and not res.get("overloaded"):
+                        lost[0] += 1
+                    elif "probs" in res:
+                        ok[0] += 1
+
+            ts = [threading.Thread(target=failover_loop, args=(i,))
+                  for i in range(clients_per * 2)]
+            for t in ts:
+                t.start()
+            time.sleep(window / 3)
+            killed_pid = router.replicas[0].pid
+            router.replicas[0].client.proc.kill()
+            for t in ts:
+                t.join()
+            failover_retries = telemetry.counter(
+                events.CTR_FLEET_RETRIES).value - retries0
+            deadline = time.monotonic() + 60
+            respawned = False
+            while time.monotonic() < deadline:
+                if router.replicas[0].healthy \
+                        and router.replicas[0].pid != killed_pid:
+                    respawned = True
+                    break
+                time.sleep(0.25)
+            phase(f"fleet: failover -> {ok[0]} ok, {lost[0]} lost, "
+                  f"{failover_retries} retried on the peer, "
+                  f"respawned={respawned}")
+        finally:
+            router.close(kill=True)
+
+        out = {
+            "fleet_replica_counts": replica_counts,
+            "fleet_qps_by_replicas": {
+                str(n): round(q, 1) for n, q in qps_by_n.items()},
+            "fleet_qps_1": round(qps_by_n.get(n_lo, 0), 1),
+            "fleet_qps_max": round(qps_by_n.get(n_hi, 0), 1),
+            "fleet_scaling_efficiency": round(efficiency, 3),
+            "fleet_clients_per_replica": clients_per,
+            "fleet_window_sec": window,
+            "fleet_max_batch": max_batch,
+            "fleet_max_wait_ms": max_wait_ms,
+            "fleet_members": members,
+            "fleet_hidden": hidden,
+            "fleet_oracle_max_abs_diff": oracle_diff,
+            "fleet_recompiles_post_warmup": int(recompiles_total),
+            "fleet_unloaded_p50_ms": round(unloaded_p50, 3),
+            "fleet_unloaded_p99_ms": round(unloaded_p99, 3),
+            "fleet_slo_p99_ms": round(slo, 3),
+            "fleet_spike_clients": spike_clients,
+            "fleet_spike_qps": round(spike_qps, 1),
+            "fleet_spike_p99_ms": round(spike_p99, 3)
+            if spike_p99 is not None else None,
+            "fleet_spike_p99_ratio": round(
+                spike_p99 / unloaded_p99, 3)
+            if spike_p99 is not None else None,
+            "fleet_spike_sheds": int(sheds),
+            "fleet_spike_shed_fraction": round(shed_fraction, 4),
+            "fleet_spike_timeouts": int(tmo),
+            "fleet_spike_errors": int(errs),
+            "fleet_failover_ok": int(ok[0]),
+            "fleet_failover_lost": int(lost[0]),
+            "fleet_failover_retries": int(failover_retries),
+            "fleet_failover_respawned": bool(respawned),
+            "fleet_canary_fraction": canary_fraction,
+            "fleet_canary_observed": round(canary_observed, 4)
+            if canary_observed is not None else None,
+            "fleet_platform": "cpu",
+        }
+        phase(f"fleet: {out['fleet_qps_1']} qps @1 -> "
+              f"{out['fleet_qps_max']} qps @{n_hi} (efficiency "
+              f"{out['fleet_scaling_efficiency']}), spike p99 ratio "
+              f"{out['fleet_spike_p99_ratio']}, canary "
+              f"{out['fleet_canary_observed']} of "
+              f"{canary_fraction}")
+        return out
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"fleet metric failed: {e}", file=sys.stderr)
+        return None
+
+
 def roofline_metric(device, phase):
     """Run ``scripts/layer_roofline.py --measure`` as a recorded phase:
     each AlexNet conv's fwd+bwd timed ALONE on the device against its
@@ -1264,6 +1637,17 @@ def main() -> None:
                   file=sys.stderr, flush=True)
         print(json.dumps(serve_metric(_phase)), flush=True)
         return
+    if "--fleet-only" in sys.argv:
+        # fast path: ONLY the Swarm fleet phase (N XLA:CPU replica
+        # subprocesses) — the ISSUE 11 acceptance gate (replica-count
+        # QPS curve + spike + failover) without the headline build
+        t0 = time.perf_counter()
+
+        def _phase(msg):
+            print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}",
+                  file=sys.stderr, flush=True)
+        print(json.dumps(fleet_metric(_phase)), flush=True)
+        return
     from veles_tpu import profiling
     from veles_tpu.backends import make_device
 
@@ -1369,6 +1753,37 @@ def main() -> None:
         "serve_window_sec": None,
         "serve_members": None,
         "serve_platform": None,
+        "fleet_replica_counts": None,
+        "fleet_qps_by_replicas": None,
+        "fleet_qps_1": None,
+        "fleet_qps_max": None,
+        "fleet_scaling_efficiency": None,
+        "fleet_clients_per_replica": None,
+        "fleet_window_sec": None,
+        "fleet_max_batch": None,
+        "fleet_max_wait_ms": None,
+        "fleet_members": None,
+        "fleet_hidden": None,
+        "fleet_oracle_max_abs_diff": None,
+        "fleet_recompiles_post_warmup": None,
+        "fleet_unloaded_p50_ms": None,
+        "fleet_unloaded_p99_ms": None,
+        "fleet_slo_p99_ms": None,
+        "fleet_spike_clients": None,
+        "fleet_spike_qps": None,
+        "fleet_spike_p99_ms": None,
+        "fleet_spike_p99_ratio": None,
+        "fleet_spike_sheds": None,
+        "fleet_spike_shed_fraction": None,
+        "fleet_spike_timeouts": None,
+        "fleet_spike_errors": None,
+        "fleet_failover_ok": None,
+        "fleet_failover_lost": None,
+        "fleet_failover_retries": None,
+        "fleet_failover_respawned": None,
+        "fleet_canary_fraction": None,
+        "fleet_canary_observed": None,
+        "fleet_platform": None,
         "conv_roofline_minibatch": None,
         "conv_roofline_layers": None,
         "conv_roofline_total_efficiency": None,
@@ -1455,6 +1870,12 @@ def main() -> None:
     sv = serve_metric(phase)
     if sv:
         record.update(sv)
+    emit()
+
+    phase("measuring fleet serving (Swarm, N XLA:CPU replicas)")
+    fl = fleet_metric(phase)
+    if fl:
+        record.update(fl)
     emit()
 
     phase("measuring per-conv roofline (layer_roofline --measure)")

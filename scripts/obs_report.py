@@ -26,7 +26,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-from veles_tpu.obs import load_dir, render  # noqa: E402
+from veles_tpu.obs import (fleet_model_rows, fleet_rows,  # noqa: E402
+                           load_dir, render, render_fleet)
 from veles_tpu.telemetry import Histogram  # noqa: E402
 
 
@@ -35,6 +36,11 @@ def main(argv=None) -> int:
     p.add_argument("metrics_dir")
     p.add_argument("--json", action="store_true",
                    help="emit the merged snapshot as one JSON object")
+    p.add_argument("--fleet", action="store_true",
+                   help="render the fleet view: per-replica rows "
+                        "(pid, resident models, queue depth, qps, "
+                        "p99) from the replica-* child dirs plus the "
+                        "per-model canary traffic split")
     p.add_argument("--events", type=int, default=40,
                    help="timeline length (default 40)")
     args = p.parse_args(argv)
@@ -44,7 +50,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     reg, snaps, journals, events = load_dir(args.metrics_dir)
-    if not snaps and not events:
+    if not snaps and not events \
+            and not fleet_rows(args.metrics_dir):
         print(f"obs_report: no metrics-*.json or journal-*.jsonl in "
               f"{args.metrics_dir} (run with --metrics-dir DIR or "
               f"$VELES_METRICS_DIR)", file=sys.stderr)
@@ -53,10 +60,24 @@ def main(argv=None) -> int:
         merged = reg.snapshot()
         merged["snapshots"] = len(snaps)
         merged["journal_events"] = len(events)
+        if args.fleet:
+            merged["fleet"] = {
+                "replicas": fleet_rows(args.metrics_dir),
+                "models": fleet_model_rows(reg, events)}
         print(json.dumps(merged))
-    else:
-        print(render(args.metrics_dir, reg, snaps, journals, events,
-                     max_events=args.events))
+        return 0
+    if args.fleet:
+        fleet = render_fleet(args.metrics_dir)
+        if not fleet:
+            print(f"obs_report: no replica-* child dirs in "
+                  f"{args.metrics_dir} — not a fleet metrics dir "
+                  f"(spawn with --serve-fleet N --metrics-dir DIR)",
+                  file=sys.stderr)
+            return 1
+        print(fleet)
+        print()
+    print(render(args.metrics_dir, reg, snaps, journals, events,
+                 max_events=args.events))
     return 0
 
 

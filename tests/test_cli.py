@@ -159,3 +159,55 @@ class TestServeModelsCli:
                      f"m={tmp_path}/nope.vpkg"])
         assert r.returncode == 2
         assert "no such package" in r.stderr
+
+
+class TestServeFleetCli:
+    """The --serve-fleet entry on the smoke-tested CLI surface (the
+    full 2-replica protocol round trip lives in tests/test_fleet.py
+    TestFleetCliProtocol)."""
+
+    def test_bad_model_spec_is_usage_error(self, tmp_path):
+        r = run_cli(["--serve-fleet", "2", "not-a-pair"])
+        assert r.returncode == 2
+        assert "NAME=PACKAGE" in r.stderr
+        assert "Traceback" not in r.stderr
+
+    def test_missing_package_is_usage_error(self, tmp_path):
+        r = run_cli(["--serve-fleet", "2",
+                     f"m={tmp_path}/nope.vpkg"])
+        assert r.returncode == 2
+        assert "no such package" in r.stderr
+
+    def test_zero_replicas_is_usage_error(self, tmp_path):
+        pkg = tmp_path / "m.vpkg"
+        pkg.write_bytes(b"x")
+        r = run_cli(["--serve-fleet", "0", f"m={pkg}"])
+        assert r.returncode == 2
+        assert ">= 1" in r.stderr
+
+    def test_bad_canary_spec_is_usage_error(self, tmp_path):
+        pkg = tmp_path / "m.vpkg"
+        pkg.write_bytes(b"x")
+        # a canary naming an unregistered model must die at parse
+        # time, before any replica spawns
+        r = run_cli(["--serve-fleet", "1", f"m={pkg}",
+                     "--canary", "ghost=m:0.5"])
+        assert r.returncode == 2
+        assert "ghost" in r.stderr
+        assert "Traceback" not in r.stderr
+
+
+class TestBenchFleetCli:
+    """bench.py --fleet-only rides the smoke-tested CLI surface like
+    --serve-only: the skip knob must short-circuit the phase cleanly
+    (the measured run lands in BENCH_r07.json)."""
+
+    def test_fleet_only_skip_short_circuits(self):
+        env = dict(os.environ)
+        env["BENCH_SKIP_FLEET"] = "1"
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--fleet-only"],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert json.loads(r.stdout.strip().splitlines()[-1]) is None

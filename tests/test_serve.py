@@ -391,6 +391,64 @@ class TestHiveResidency:
         assert {e["model"] for e in spills} >= {"alpha"}
 
 
+class TestReplicaDeathClient:
+    """Reader-thread death handling (ISSUE 11 satellite): a caller
+    blocked on a dead replica must fail IMMEDIATELY with the
+    distinguishable ReplicaDied error — never by waiting out its own
+    request timeout."""
+
+    def test_kill_mid_request_fails_waiters_immediately(
+            self, packages, tmp_path_factory):
+        from veles_tpu.serve.client import HiveClient, ReplicaDied
+        # max_wait_ms=5000 parks the lone request in the batcher's
+        # coalescing window, so it is GUARANTEED still pending when
+        # the kill lands
+        c = HiveClient({"alpha": packages["alpha"]["pkg"]},
+                       backend="cpu", max_batch=8, max_wait_ms=5000,
+                       cwd=REPO)
+        try:
+            jid = c.submit("alpha", np.ones((1, 6, 6, 1), np.float32))
+            time.sleep(0.3)
+            c.proc.kill()
+            t0 = time.perf_counter()
+            with pytest.raises(ReplicaDied) as ei:
+                c.wait_for(jid, timeout=60.0)
+            dt = time.perf_counter() - t0
+            # failed the moment the reader saw EOF, not at the 60s
+            # (or even the 5s batcher-window) mark
+            assert dt < 5.0, dt
+            assert not isinstance(ei.value, TimeoutError)
+            assert c.dead
+            # and a submit against the corpse is the same loud error
+            with pytest.raises(ReplicaDied):
+                for _ in range(50):   # the pipe may buffer one write
+                    c.submit("alpha", np.ones((1, 6, 6, 1),
+                                              np.float32))
+                    time.sleep(0.05)
+        finally:
+            c.close(kill=True)
+
+    def test_collect_async_fires_on_death(self, packages):
+        from veles_tpu.serve.client import HiveClient
+        c = HiveClient({"alpha": packages["alpha"]["pkg"]},
+                       backend="cpu", max_batch=8, max_wait_ms=5000,
+                       cwd=REPO)
+        got = []
+        done = threading.Event()
+        try:
+            jid = c.submit("alpha", np.ones((1, 6, 6, 1), np.float32))
+            c.collect_async(jid, lambda msg, err:
+                            (got.append((msg, err)), done.set()))
+            time.sleep(0.2)
+            c.proc.kill()
+            assert done.wait(timeout=10), "callback never fired"
+            msg, err = got[0]
+            assert msg is None and err is not None
+            assert type(err).__name__ == "ReplicaDied"
+        finally:
+            c.close(kill=True)
+
+
 class TestEngineSubmitApi:
     """The request-level EnsembleEvalEngine facade in-process: the
     refactor the serving tier rides (submit -> Future instead of

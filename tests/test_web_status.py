@@ -158,3 +158,105 @@ class TestMetricsDashboard:
         urllib.request.urlopen(urllib.request.Request(
             url(mserver, "/api/update"), data=body), timeout=5)
         assert get_json(mserver, "/api/status")["r1"]["epoch"] == 1
+
+
+class TestFleetDashboard:
+    """Fleet mode (ISSUE 11 satellite): a metrics dir with replica-*
+    child dirs renders per-replica rows (pid, resident models, queue
+    depth, qps, p99) and the per-model canary split on the dashboard,
+    in /api/metrics, and through obs_report --fleet."""
+
+    @pytest.fixture
+    def fleet_dir(self, tmp_path):
+        import time as _t
+
+        from veles_tpu.telemetry import Registry
+        now = round(_t.time(), 3)
+        for i, (pid, reqs) in enumerate(((111, 500), (222, 400))):
+            d = tmp_path / f"replica-{i}"
+            d.mkdir()
+            reg = Registry()
+            reg.counter("serve.requests").inc(reqs)
+            reg.gauge("serve.models_resident").set(2)
+            reg.gauge("serve.queue_depth").set(1)
+            for _ in range(10):
+                reg.histogram("serve.request_seconds").record(0.005)
+            snap = reg.snapshot()
+            snap["pid"], snap["ts"] = pid, now
+            (d / f"metrics-{pid}.json").write_text(json.dumps(snap))
+            (d / f"journal-{pid}.jsonl").write_text(json.dumps(
+                {"ts": now - 10.0, "event": "serve.ready",
+                 "pid": pid}) + "\n")
+        # the router process's own registry: per-model traffic split
+        reg = Registry()
+        reg.counter("fleet.requests").inc(900)
+        reg.counter("fleet.model.primary.requests").inc(900)
+        reg.counter("fleet.model.shadow.requests").inc(90)
+        reg.counter("fleet.model.shadow.mirrored").inc(90)
+        for _ in range(5):
+            reg.histogram(
+                "fleet.model.primary.request_seconds").record(0.006)
+        snap = reg.snapshot()
+        snap["pid"], snap["ts"] = 99, now
+        (tmp_path / "metrics-99.json").write_text(json.dumps(snap))
+        (tmp_path / "journal-99.jsonl").write_text(json.dumps(
+            {"ts": now - 11.0, "event": "fleet.ready",
+             "canaries": {"shadow": {"of": "primary",
+                                     "fraction": 0.1}}}) + "\n")
+        return str(tmp_path)
+
+    @pytest.fixture
+    def fserver(self, fleet_dir):
+        s = WebStatusServer(port=0, host="127.0.0.1",
+                            metrics_dir=fleet_dir)
+        s.start_background()
+        yield s
+        s.shutdown()
+
+    def test_fleet_rows_read_child_snapshots(self, fleet_dir):
+        from veles_tpu.obs import fleet_rows
+        rows = fleet_rows(fleet_dir)
+        assert [r["replica"] for r in rows] == [0, 1]
+        assert rows[0]["pid"] == 111 and rows[1]["pid"] == 222
+        assert rows[0]["models_resident"] == 2
+        assert rows[0]["queue_depth"] == 1
+        # 500 requests over the 10s ready->flush wall
+        assert rows[0]["qps"] == pytest.approx(50.0, abs=0.5)
+        assert rows[0]["p99_ms"] == pytest.approx(5.0, rel=0.2)
+
+    def test_dashboard_renders_fleet_view(self, fserver):
+        with urllib.request.urlopen(url(fserver, "/"),
+                                    timeout=5) as r:
+            page = r.read().decode()
+        assert "fleet replicas" in page
+        assert "111" in page and "222" in page
+        assert "fleet per-model split" in page
+        assert "canary-of:primary" in page
+
+    def test_api_metrics_carries_fleet_block(self, fserver):
+        snap = get_json(fserver, "/api/metrics")
+        assert len(snap["fleet"]["replicas"]) == 2
+        models = {m["model"]: m for m in snap["fleet"]["models"]}
+        assert models["shadow"]["canary_of"] == "primary"
+        assert models["shadow"]["mirrored"] == 90
+        # the A/B split: shadow sees ~10% of primary's traffic
+        assert models["shadow"]["share"] == pytest.approx(
+            90 / 990, abs=0.01)
+
+    def test_obs_report_fleet_flag(self, fleet_dir, capsys):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "scripts"))
+        try:
+            import obs_report
+        finally:
+            sys.path.pop(0)
+        assert obs_report.main([fleet_dir, "--fleet"]) == 0
+        out = capsys.readouterr().out
+        assert "fleet replicas" in out
+        assert "canary-of:primary" in out
+        # a non-fleet dir declines the flag loudly
+        assert obs_report.main(
+            [os.path.join(fleet_dir, "replica-0"), "--fleet"]) == 1

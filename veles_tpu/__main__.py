@@ -157,6 +157,13 @@ def main(argv=None) -> int:
         # and is resumed with warm caches)
         from veles_tpu.serve import hive
         return hive.main([a for a in argv if a != "--serve-models"])
+    if "--serve-fleet" in argv:
+        # Swarm (docs/guide.md "Fleet serving"): N hive replicas
+        # behind one SLO-aware router, speaking the same JSONL
+        # protocol — intercepted like --serve-models; the replica
+        # count rides as the first positional
+        from veles_tpu.serve import router
+        return router.main([a for a in argv if a != "--serve-fleet"])
     # root.* overrides can appear anywhere; apply AFTER config files,
     # so collect them first but apply later.
     overrides = [a for a in argv if a.startswith("root.") and "=" in a]

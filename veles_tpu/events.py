@@ -104,6 +104,14 @@ EV_SERVE_FIRST_DISPATCH = _ev("serve.first_dispatch")
 EV_SERVE_DRAIN = _ev("serve.drain")
 EV_SERVE_SHUTDOWN = _ev("serve.shutdown")
 
+EV_FLEET_READY = _ev("fleet.ready")
+EV_FLEET_PLACEMENT = _ev("fleet.placement")
+EV_FLEET_REPLICA_SPAWNED = _ev("fleet.replica_spawned")
+EV_FLEET_REPLICA_DIED = _ev("fleet.replica_died")
+EV_FLEET_REPLICA_RESPAWNED = _ev("fleet.replica_respawned")
+EV_FLEET_DRAIN = _ev("fleet.drain")
+EV_FLEET_SHUTDOWN = _ev("fleet.shutdown")
+
 EV_SUPERVISOR_RESTART = _ev("supervisor.restart")
 EV_SUPERVISOR_RESUMED = _ev("supervisor.resumed")
 EV_SUPERVISOR_SHUTDOWN = _ev("supervisor.shutdown")
@@ -143,6 +151,14 @@ CTR_SERVE_BATCH_SLOTS = _ctr("serve.batch_slots")
 CTR_SERVE_COMPILES = _ctr("serve.compiles")
 CTR_SERVE_SPILLS = _ctr("serve.spills")
 
+CTR_FLEET_REQUESTS = _ctr("fleet.requests")
+CTR_FLEET_REQUEST_ERRORS = _ctr("fleet.request_errors")
+CTR_FLEET_SHED = _ctr("fleet.shed")
+CTR_FLEET_RETRIES = _ctr("fleet.retries")
+CTR_FLEET_MIRRORED = _ctr("fleet.mirrored")
+CTR_FLEET_REPLICA_DEATHS = _ctr("fleet.replica_deaths")
+CTR_FLEET_REPLICA_RESPAWNS = _ctr("fleet.replica_respawns")
+
 CTR_EVALUATOR_JOBS = _ctr("evaluator.jobs")
 CTR_EVALUATOR_JOB_ERRORS = _ctr("evaluator.job_errors")
 
@@ -172,6 +188,11 @@ GAUGE_SERVE_RESIDENT_BYTES = _gauge("serve.resident_bytes")
 GAUGE_SERVE_FIRST_DISPATCH_SECONDS = _gauge(
     "serve.first_dispatch_seconds")
 
+GAUGE_FLEET_REPLICAS_HEALTHY = _gauge("fleet.replicas_healthy")
+GAUGE_FLEET_INFLIGHT = _gauge("fleet.inflight")
+GAUGE_FLEET_EST_WAIT_MS = _gauge("fleet.est_wait_ms")
+GAUGE_FLEET_DISPATCH_EMA_MS = _gauge("fleet.dispatch_ema_ms")
+
 GAUGE_GA_LAST_HANG_WAIT = _gauge("ga.last_hang_wait")
 GAUGE_PREEMPT_SNAPSHOT_SECONDS = _gauge("preempt.snapshot_seconds")
 GAUGE_MULTIHOST_PEER_HEARTBEAT_AGE = _gauge(
@@ -189,6 +210,7 @@ HIST_ENSEMBLE_DISPATCH_SECONDS = _hist("ensemble.dispatch_seconds")
 HIST_ENSEMBLE_SCORE_SECONDS = _hist("ensemble.score_seconds")
 HIST_SUPERVISOR_DOWNTIME_SECONDS = _hist(
     "supervisor.downtime_seconds")
+HIST_FLEET_REQUEST_SECONDS = _hist("fleet.request_seconds")
 HIST_SERVE_REQUEST_SECONDS = _hist("serve.request_seconds")
 HIST_SERVE_DISPATCH_SECONDS = _hist("serve.dispatch_seconds")
 HIST_SERVE_BATCH_ROWS = _hist("serve.batch_rows")
@@ -204,11 +226,20 @@ SPAN_EVALUATOR_JOB_SECONDS = _span("evaluator.job_seconds")
 #: histograms, ``fused.first_<kind>_dispatch_seconds`` gauges, and
 #: ``fused.<kind>_seconds`` / ``fused.<kind>_images`` counters, where
 #: <kind> is the fused step kind (train/eval/...)
+#: ...plus the fleet router's per-model traffic split (the canary A/B
+#: read): ``fleet.model.<name>.requests`` / ``.errors`` / ``.shed`` /
+#: ``.mirrored`` counters and a ``fleet.model.<name>.request_seconds``
+#: histogram, where <name> is the served model's registered name
 DYNAMIC_FAMILIES = (
     "fused.<kind>_dispatch_seconds",
     "fused.first_<kind>_dispatch_seconds",
     "fused.<kind>_seconds",
     "fused.<kind>_images",
+    "fleet.model.<name>.requests",
+    "fleet.model.<name>.errors",
+    "fleet.model.<name>.shed",
+    "fleet.model.<name>.mirrored",
+    "fleet.model.<name>.request_seconds",
 )
 
 

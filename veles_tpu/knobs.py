@@ -157,6 +157,34 @@ SERVE_HBM_BUDGET = _knob(
     "reports no bytes_limit; over budget the LRU model spills to "
     "host.")
 
+# -- fleet serving (Swarm) ---------------------------------------------
+
+FLEET_SLO_P99_MS = _knob(
+    "VELES_FLEET_SLO_P99_MS", 0.0, float,
+    "Fleet admission-control SLO target: when a request's estimated "
+    "completion (queue depth x observed per-dispatch time + batching "
+    "window) would exceed this many milliseconds on EVERY candidate "
+    "replica, the router sheds it with an explicit `overloaded` "
+    "response instead of letting p99 run away (0 disables shedding).")
+FLEET_MAX_INFLIGHT = _knob(
+    "VELES_FLEET_MAX_INFLIGHT", 64, int,
+    "Hard per-replica bound on router-side in-flight requests (the "
+    "bounded router queue); a request that finds every candidate "
+    "replica at the bound is shed `overloaded`.")
+FLEET_HEARTBEAT_DEADLINE = _knob(
+    "VELES_FLEET_HEARTBEAT_DEADLINE", 30.0, float,
+    "Seconds of replica stdout silence (no heartbeat, no response) "
+    "before the fleet monitor declares the replica hung, kills it, "
+    "and respawns (0 disables).")
+FLEET_CANARY_FRACTION = _knob(
+    "VELES_FLEET_CANARY_FRACTION", 0.1, float,
+    "Default traffic fraction mirrored to a `canary-of:NAME` model "
+    "when its registration does not carry an explicit split.")
+FLEET_RESPAWN_BACKOFF = _knob(
+    "VELES_FLEET_RESPAWN_BACKOFF", 0.5, float,
+    "Initial seconds the fleet monitor backs off before respawning a "
+    "dead replica (doubles per consecutive death, capped at 30s).")
+
 # -- observability -----------------------------------------------------
 
 METRICS_DIR = _knob(

@@ -17,6 +17,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 import sys
 import time
 
@@ -72,6 +73,152 @@ def load_dir(metrics_dir: str):
             continue
     events.sort(key=lambda e: e.get("ts", 0.0))
     return reg, snaps, journals, events
+
+
+def fleet_replica_dirs(metrics_dir: str):
+    """[(replica index, child dir)] — the per-replica metrics dirs a
+    FleetRouter spawns its hives with (``replica-<i>/``)."""
+    out = []
+    try:
+        names = sorted(os.listdir(metrics_dir))
+    except OSError:
+        return out
+    for fn in names:
+        if not fn.startswith("replica-"):
+            continue
+        path = os.path.join(metrics_dir, fn)
+        if not os.path.isdir(path):
+            continue
+        try:
+            idx = int(fn.split("-")[-1])
+        except ValueError:
+            continue
+        out.append((idx, path))
+    return sorted(out)
+
+
+def fleet_rows(metrics_dir: str):
+    """Per-replica fleet view rows from the merged child snapshots:
+    pid (of the NEWEST snapshot — respawns leave older pids behind),
+    resident models, live queue depth, lifetime qps (requests over
+    the ready->last-flush wall), and request p99."""
+    rows = []
+    for idx, path in fleet_replica_dirs(metrics_dir):
+        reg, snaps, _journals, events = load_dir(path)
+        pid = None
+        last_ts = None
+        newest = max(snaps, key=os.path.getmtime, default=None)
+        if newest is not None:
+            stem = os.path.basename(newest)
+            try:
+                pid = int(stem.split("-")[-1].split(".")[0])
+            except ValueError:
+                pid = None
+            try:
+                with open(newest) as f:
+                    last_ts = json.load(f).get("ts")
+            except (OSError, ValueError):
+                last_ts = None
+        readies = [e for e in events
+                   if e.get("event") == "serve.ready"]
+        requests = reg.counters.get("serve.requests")
+        requests = requests.value if requests else 0
+        qps = None
+        if readies and last_ts and last_ts > readies[0].get("ts", 0):
+            qps = requests / (last_ts - readies[0]["ts"])
+        g_res = reg.gauges.get("serve.models_resident")
+        g_q = reg.gauges.get("serve.queue_depth")
+        h = reg.histograms.get("serve.request_seconds")
+        rows.append({
+            "replica": idx,
+            "pid": pid,
+            "spawns": len(readies),
+            "models_resident": g_res.value if g_res else None,
+            "queue_depth": g_q.value if g_q else None,
+            "requests": requests,
+            "qps": round(qps, 1) if qps is not None else None,
+            "p99_ms": round(1000 * h.quantile(0.99), 3)
+            if h and h.count else None,
+        })
+    return rows
+
+
+def fleet_model_rows(reg: Registry, events):
+    """The per-model traffic split (the canary A/B read) from the
+    ROUTER process's registry: one row per ``fleet.model.<name>.*``
+    family, annotated with its canary registration from the last
+    ``fleet.ready`` journal event."""
+    models = {}
+    for n, c in reg.counters.items():
+        m = re.match(r"fleet\.model\.(.+)\.(requests|errors|shed|"
+                     r"mirrored)$", n)
+        if m:
+            models.setdefault(m.group(1), {})[m.group(2)] = c.value
+    canaries = {}
+    for ev in events:
+        if ev.get("event") == "fleet.ready" and ev.get("canaries"):
+            canaries = ev["canaries"]
+    total = sum(d.get("requests", 0) for d in models.values()) or 1
+    rows = []
+    for name in sorted(models):
+        d = models[name]
+        h = reg.histograms.get(f"fleet.model.{name}.request_seconds")
+        c = canaries.get(name)
+        rows.append({
+            "model": name,
+            "requests": d.get("requests", 0),
+            "share": round(d.get("requests", 0) / total, 4),
+            "errors": d.get("errors", 0),
+            "shed": d.get("shed", 0),
+            "mirrored": d.get("mirrored", 0),
+            "p50_ms": round(1000 * h.quantile(0.5), 3)
+            if h and h.count else None,
+            "p99_ms": round(1000 * h.quantile(0.99), 3)
+            if h and h.count else None,
+            "canary_of": c.get("of") if c else None,
+            "canary_fraction": c.get("fraction") if c else None,
+        })
+    return rows
+
+
+def render_fleet(metrics_dir: str) -> str:
+    """The fleet view: per-replica rows + the per-model canary split.
+    Empty string when ``metrics_dir`` holds no ``replica-*`` child
+    dirs (not a fleet)."""
+    rows = fleet_rows(metrics_dir)
+    if not rows:
+        return ""
+    reg, _snaps, _journals, events = load_dir(metrics_dir)
+    out = ["-- fleet replicas --",
+           f"  {'replica':>7} {'pid':>8} {'spawns':>6} "
+           f"{'resident':>8} {'queue':>6} {'requests':>9} "
+           f"{'qps':>9} {'p99 ms':>9}"]
+    for r in rows:
+        pid = "-" if r["pid"] is None else str(r["pid"])
+        out.append(
+            f"  {r['replica']:>7} {pid:>8} "
+            f"{r['spawns']:>6} {_fmt(r['models_resident']):>8} "
+            f"{_fmt(r['queue_depth']):>6} {_fmt(r['requests']):>9} "
+            f"{_fmt(r['qps']):>9} {_fmt(r['p99_ms']):>9}")
+    mrows = fleet_model_rows(reg, events)
+    if mrows:
+        out.append("")
+        out.append("-- fleet per-model split --")
+        out.append(f"  {'model':<16} {'requests':>9} {'share':>7} "
+                   f"{'p50 ms':>9} {'p99 ms':>9} {'errors':>7} "
+                   f"{'shed':>6} {'mirrored':>8}  note")
+        for m in mrows:
+            note = ""
+            if m["canary_of"]:
+                note = f"canary-of:{m['canary_of']} " \
+                       f"@{m['canary_fraction']}"
+            out.append(
+                f"  {m['model']:<16} {_fmt(m['requests']):>9} "
+                f"{_fmt(m['share']):>7} {_fmt(m['p50_ms']):>9} "
+                f"{_fmt(m['p99_ms']):>9} {_fmt(m['errors']):>7} "
+                f"{_fmt(m['shed']):>6} {_fmt(m['mirrored']):>8}  "
+                f"{note}".rstrip())
+    return "\n".join(out)
 
 
 def _fmt(v) -> str:

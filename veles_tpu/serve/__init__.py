@@ -16,8 +16,16 @@ steady state runs with ZERO recompiles.
 - :mod:`veles_tpu.serve.hive` — the serving process
   (``python -m veles_tpu --serve-models NAME=PKG ...``);
 - :mod:`veles_tpu.serve.client` — the line-protocol client used by
-  tests, bench.py, and operators' smoke probes.
+  tests, bench.py, and operators' smoke probes;
+- :mod:`veles_tpu.serve.fleet` — replica lifecycle (spawn / monitor /
+  respawn) and the model placement policy;
+- :mod:`veles_tpu.serve.router` — Swarm, the SLO-aware fleet router
+  (``python -m veles_tpu --serve-fleet N NAME=PKG ...``): N hive
+  replicas, placement-aware least-loaded routing, once-on-a-peer
+  failover, canary traffic mirroring, and admission-control shedding.
 """
 
 from veles_tpu.serve.batcher import MicroBatcher  # noqa: F401
+from veles_tpu.serve.client import ReplicaDied  # noqa: F401
+from veles_tpu.serve.fleet import PlacementPolicy  # noqa: F401
 from veles_tpu.serve.residency import ResidencyManager  # noqa: F401

@@ -5,7 +5,14 @@ CONCURRENT callers over its stdin/stdout: every request draws a wire
 id under a lock, a single reader thread routes response lines back to
 per-id waiters, and heartbeats/garbage are tolerated as proof of life
 (the ChipEvaluatorPool discipline).  This is the surface the serving
-tests, bench.py's ``serve_*`` phases, and operator smoke probes share.
+tests, bench.py's ``serve_*``/``fleet_*`` phases, the FleetRouter's
+replicas (veles_tpu/serve/fleet.py), and operator smoke probes share.
+
+Death semantics: when the replica's stdout reaches EOF (process exit,
+SIGKILL, pipe loss), EVERY pending waiter fails immediately with
+:class:`ReplicaDied` — never by waiting out its own timeout.  The
+fleet router catches exactly that error to retry the request on a
+healthy peer.
 """
 
 from __future__ import annotations
@@ -17,9 +24,24 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
+
+
+class ReplicaDied(RuntimeError):
+    """The serving subprocess died (EOF/exit) with requests pending.
+
+    Distinguishable from :class:`TimeoutError` by construction: a
+    caller blocked on a dead replica is failed the moment the reader
+    thread sees EOF, so failover can retry on a peer immediately
+    instead of burning the request timeout.  ``rc`` carries the
+    replica's exit code when known (None while it is still dying).
+    """
+
+    def __init__(self, msg: str, rc: Optional[int] = None) -> None:
+        super().__init__(msg)
+        self.rc = rc
 
 
 class HiveClient:
@@ -32,6 +54,7 @@ class HiveClient:
                  hbm_budget: Optional[int] = None,
                  heartbeat_every: Optional[float] = None,
                  metrics_dir: Optional[str] = None,
+                 install_dir: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None,
                  cwd: Optional[str] = None,
                  start_timeout: float = 120.0) -> None:
@@ -48,6 +71,10 @@ class HiveClient:
             cmd += ["--heartbeat-every", str(heartbeat_every)]
         if metrics_dir is not None:
             cmd += ["--metrics-dir", metrics_dir]
+        if install_dir is not None:
+            # a respawned replica reuses its predecessor's install dir,
+            # so the checksum-verified package unpack is warm
+            cmd += ["--install-dir", install_dir]
         run_env = dict(os.environ)
         run_env.setdefault("JAX_PLATFORMS", "cpu")
         if env:
@@ -58,10 +85,19 @@ class HiveClient:
         self._wlock = threading.Lock()
         self._cond = threading.Condition()
         self._results: Dict[int, Dict[str, Any]] = {}
+        #: async collectors (wire id -> callback) — the canary-mirror
+        #: path records telemetry without parking a thread per request
+        self._callbacks: Dict[int, Callable[[Optional[Dict[str, Any]],
+                                             Optional[BaseException]],
+                                            None]] = {}
         self._next_id = 0
         self._eof = False
+        self.exit_rc: Optional[int] = None
         self.hello: Optional[Dict[str, Any]] = None
         self.heartbeats = 0
+        #: monotonic time of the last stdout line (ANY line is proof of
+        #: life — the pool/fleet heartbeat-deadline discipline)
+        self.last_line_ts = time.monotonic()
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True,
                                         name="hive-client-reader")
@@ -81,31 +117,70 @@ class HiveClient:
 
     # -- wire ----------------------------------------------------------
 
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid
+
+    @property
+    def dead(self) -> bool:
+        """True once the reader saw EOF or the process exited."""
+        return self._eof or self.proc.poll() is not None
+
     def _read_loop(self) -> None:
         for line in self.proc.stdout:
             line = line.strip()
+            self.last_line_ts = time.monotonic()
             if not line:
                 continue
             try:
                 msg = json.loads(line)
             except ValueError:
                 continue   # non-protocol noise is proof of life
+            cb = None
             with self._cond:
                 if msg.get("ready"):
                     self.hello = msg
                 elif "hb" in msg:
                     self.heartbeats += 1
                 elif msg.get("id") is not None:
-                    self._results[msg["id"]] = msg
+                    cb = self._callbacks.pop(msg["id"], None)
+                    if cb is None:
+                        self._results[msg["id"]] = msg
                 self._cond.notify_all()
+            if cb is not None:
+                self._run_callback(cb, msg, None)
+        # EOF: the replica is gone — fail EVERY pending waiter and
+        # async collector NOW (a caller must never wait out its own
+        # timeout against a dead replica)
+        rc = self.proc.poll()
+        err = ReplicaDied(
+            f"hive pid {self.proc.pid} closed its pipe (rc={rc})",
+            rc=rc)
         with self._cond:
             self._eof = True
+            self.exit_rc = rc
+            callbacks = list(self._callbacks.values())
+            self._callbacks.clear()
             self._cond.notify_all()
+        for cb in callbacks:
+            self._run_callback(cb, None, err)
+
+    @staticmethod
+    def _run_callback(cb, msg, err) -> None:
+        try:
+            cb(msg, err)
+        except Exception:  # noqa: BLE001 — a collector must not kill
+            pass           # the reader thread
 
     def _send(self, obj: Dict[str, Any]) -> None:
-        with self._wlock:
-            self.proc.stdin.write(json.dumps(obj) + "\n")
-            self.proc.stdin.flush()
+        try:
+            with self._wlock:
+                self.proc.stdin.write(json.dumps(obj) + "\n")
+                self.proc.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError) as e:
+            raise ReplicaDied(
+                f"hive pid {self.proc.pid} stdin is gone ({e})",
+                rc=self.proc.poll()) from e
 
     def _draw_id(self) -> int:
         with self._wlock:
@@ -116,14 +191,14 @@ class HiveClient:
         deadline = time.monotonic() + timeout
         with self._cond:
             while jid not in self._results:
+                if self._eof:
+                    raise ReplicaDied(
+                        f"hive pid {self.proc.pid} died before "
+                        f"answering request {jid}", rc=self.exit_rc)
                 left = deadline - time.monotonic()
                 if left <= 0:
                     raise TimeoutError(
                         f"no response for request {jid} in {timeout}s")
-                if self._eof and jid not in self._results:
-                    raise RuntimeError(
-                        "hive closed the pipe before answering "
-                        f"request {jid}")
                 self._cond.wait(min(left, 0.5))
             return self._results.pop(jid)
 
@@ -131,12 +206,35 @@ class HiveClient:
 
     def submit(self, model: str, rows: Any) -> int:
         """Fire one request without waiting; returns its wire id
-        (collect with :meth:`wait_for`).  The SIGTERM-drain test and
-        the sustained-QPS bench issue bursts through this."""
+        (collect with :meth:`wait_for` or :meth:`collect_async`)."""
         jid = self._draw_id()
         self._send({"id": jid, "model": model,
                     "rows": np.asarray(rows, np.float32).tolist()})
         return jid
+
+    def collect_async(self, jid: int,
+                      callback: Callable[[Optional[Dict[str, Any]],
+                                          Optional[BaseException]],
+                                         None]) -> None:
+        """Route request ``jid``'s response to ``callback(msg, err)``
+        on the reader thread instead of a blocking waiter (exactly one
+        of msg/err is set; err is :class:`ReplicaDied` when the
+        replica dies first).  The callback must be quick and must not
+        raise — the fleet's canary mirror records telemetry here."""
+        ready = None
+        with self._cond:
+            if jid in self._results:
+                ready = self._results.pop(jid)
+            elif self._eof:
+                ready = ReplicaDied(
+                    f"hive pid {self.proc.pid} died before answering "
+                    f"request {jid}", rc=self.exit_rc)
+            else:
+                self._callbacks[jid] = callback
+        if isinstance(ready, ReplicaDied):
+            self._run_callback(callback, None, ready)
+        elif ready is not None:
+            self._run_callback(callback, ready, None)
 
     def wait_for(self, jid: int,
                  timeout: float = 60.0) -> Dict[str, Any]:
@@ -156,6 +254,9 @@ class HiveClient:
 
     def sigterm(self) -> None:
         self.proc.send_signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self.proc.kill()
 
     def wait(self, timeout: float = 60.0) -> int:
         return self.proc.wait(timeout=timeout)

@@ -1,0 +1,634 @@
+"""Swarm: the fleet router — N Hive replicas behind one front end.
+
+``python -m veles_tpu --serve-fleet N NAME=PKG.vpkg [NAME=PKG ...]``
+
+PR 10's Hive is ONE chip-owning process, so serving throughput is
+capped by one process no matter how many cores/chips the box has.
+:class:`FleetRouter` owns N Hive replicas
+(:mod:`veles_tpu.serve.fleet` spawns and supervises them) and fans
+concurrent requests out across them:
+
+- **placement-aware routing**: a :class:`~veles_tpu.serve.fleet.
+  PlacementPolicy` replicates hot models on every replica and
+  partitions the long tail; a request goes to the LEAST-LOADED healthy
+  replica holding the model (router-side in-flight queue depth per
+  replica), falling back to any healthy replica (which LRU-loads the
+  model under its own residency budget);
+- **failover**: a replica death (reader EOF or heartbeat deadline)
+  fails its in-flight requests with ``ReplicaDied`` *immediately*;
+  the router retries each exactly once on a healthy peer (inference
+  is idempotent) while the fleet monitor respawns the replica with a
+  warm install dir — pending waiters NEVER hang;
+- **admission control**: a bounded per-replica router queue plus an
+  SLO target (``$VELES_FLEET_SLO_P99_MS``): when the estimated
+  completion (queue depth x observed per-dispatch time + batching
+  window) would blow the target on even the least-loaded candidate,
+  the request is shed with an explicit ``overloaded`` response
+  instead of letting p99 run away;
+- **canary / shadow**: a model registered as ``canary-of:NAME``
+  receives a sampled fraction of NAME's traffic as asynchronous
+  mirrors; per-model QPS/latency/error telemetry is split
+  (``fleet.model.<name>.*``) so the A/B reads directly from
+  ``obs_report --fleet``.
+
+The CLI front end speaks the same JSONL protocol as a single hive
+(hello line, heartbeats, ``{"id", "model", "rows"}`` in /
+``{"id", "pred", "probs"}`` out), so every Hive client — including
+another router — can point at a fleet unchanged.  Shed responses are
+``{"id", "error": "overloaded", "overloaded": true}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import signal
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from veles_tpu import events, knobs, telemetry
+from veles_tpu.logger import Logger
+from veles_tpu.serve.client import ReplicaDied
+from veles_tpu.serve.fleet import PlacementPolicy, Replica, ReplicaSet
+from veles_tpu.supervisor import EXIT_PREEMPTED
+
+
+class FleetRouter(Logger):
+    """Own N Hive replicas; route, shed, mirror, and fail over."""
+
+    def __init__(self, models: Dict[str, str], n_replicas: int,
+                 backend: str = "cpu",
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 hbm_budget: Optional[int] = None,
+                 heartbeat_every: Optional[float] = None,
+                 metrics_dir: Optional[str] = None,
+                 cwd: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 canaries: Optional[Dict[str,
+                                         Tuple[str,
+                                               Optional[float]]]] = None,
+                 placement: Optional[PlacementPolicy] = None,
+                 slo_p99_ms: Optional[float] = None,
+                 max_inflight: Optional[int] = None,
+                 heartbeat_deadline: Optional[float] = None,
+                 respawn_backoff: Optional[float] = None,
+                 start_timeout: float = 300.0) -> None:
+        if n_replicas < 1:
+            raise ValueError(f"a fleet needs >= 1 replica, got "
+                             f"{n_replicas}")
+        if not models:
+            raise ValueError("a fleet needs at least one model")
+        self.models = dict(models)
+        self.n_replicas = int(n_replicas)
+        #: {canary_name: (primary_name, fraction)} — validated here
+        self.canaries: Dict[str, Tuple[str, float]] = {}
+        default_frac = float(knobs.get(knobs.FLEET_CANARY_FRACTION))
+        for cname, (primary, frac) in (canaries or {}).items():
+            if cname not in self.models:
+                raise ValueError(f"canary {cname!r} is not a "
+                                 f"registered model")
+            if primary not in self.models:
+                raise ValueError(f"canary {cname!r} mirrors unknown "
+                                 f"model {primary!r}")
+            if cname == primary:
+                raise ValueError(f"{cname!r} cannot canary itself")
+            f = default_frac if frac is None else float(frac)
+            if not 0.0 <= f <= 1.0:
+                raise ValueError(f"canary fraction must be in [0, 1], "
+                                 f"got {f} for {cname!r}")
+            self.canaries[cname] = (primary, f)
+        self._mirrors_by_primary: Dict[str, List[Tuple[str, float]]] \
+            = {}
+        for cname, (primary, f) in self.canaries.items():
+            self._mirrors_by_primary.setdefault(primary, []).append(
+                (cname, f))
+        #: admission knobs — plain mutable attributes so an operator
+        #: embedding the router (or a test) can retune a live fleet
+        self.slo_p99_ms = float(slo_p99_ms) if slo_p99_ms is not None \
+            else float(knobs.get(knobs.FLEET_SLO_P99_MS))
+        self.max_inflight = int(max_inflight) \
+            if max_inflight is not None \
+            else int(knobs.get(knobs.FLEET_MAX_INFLIGHT))
+        if metrics_dir:
+            telemetry.configure(metrics_dir)
+        self.metrics_dir = metrics_dir
+
+        self.replicas = [
+            Replica(i, self.models, backend=backend,
+                    max_batch=max_batch, max_wait_ms=max_wait_ms,
+                    hbm_budget=hbm_budget,
+                    heartbeat_every=heartbeat_every,
+                    metrics_dir=metrics_dir, cwd=cwd, env=env,
+                    start_timeout=start_timeout)
+            for i in range(self.n_replicas)]
+        self.fleet = ReplicaSet(
+            self.replicas, heartbeat_deadline=heartbeat_deadline,
+            respawn_backoff=respawn_backoff)
+        hellos = self.fleet.start()
+        self.hello_models = hellos[0].get("models", {})
+
+        #: routing affinity: hot models on all replicas, long tail
+        #: partitioned (any healthy replica remains a fallback)
+        policy = placement or PlacementPolicy(budget_bytes=hbm_budget)
+        self.placement = policy.assign(
+            {name: self.hello_models.get(name, {})
+             .get("param_bytes", 0) for name in self.models},
+            self.n_replicas)
+        self._lock = threading.Lock()
+        self._routed = [0] * self.n_replicas
+        self._mirror_acc: Dict[str, float] = {}
+        self._closed = False
+        telemetry.event(events.EV_FLEET_PLACEMENT,
+                        placement=self.placement)
+        telemetry.event(
+            events.EV_FLEET_READY, replicas=self.n_replicas,
+            pids=[h.get("pid") for h in hellos],
+            models=sorted(self.models),
+            canaries={c: {"of": p, "fraction": f}
+                      for c, (p, f) in self.canaries.items()},
+            slo_p99_ms=self.slo_p99_ms,
+            max_inflight=self.max_inflight)
+        self.info("fleet up: %d replicas (pids %s), %d models, "
+                  "placement %s", self.n_replicas,
+                  [h.get("pid") for h in hellos], len(self.models),
+                  self.placement)
+
+    # -- routing -------------------------------------------------------
+
+    def _pick(self, model: str,
+              exclude: Tuple[Replica, ...] = ()) -> Optional[Replica]:
+        """The least-loaded healthy replica holding ``model``; any
+        healthy replica when none of the placed set is (the fallback
+        LRU-loads the model on arrival)."""
+        placed = set(self.placement.get(model, ()))
+        healthy = [r for r in self.fleet.healthy()
+                   if r not in exclude]
+        candidates = [r for r in healthy if r.idx in placed] \
+            or healthy
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: (r.inflight, r.idx))
+
+    def _shed(self, r: Replica) -> Optional[float]:
+        """Admission control on the picked (least-loaded) candidate:
+        returns the estimated completion in ms when the request must
+        be shed, None when it is admitted.  Checking only the pick is
+        sound because _pick minimizes queue depth — if the best
+        replica sheds, every other candidate is deeper."""
+        if r.inflight >= self.max_inflight:
+            return float(r.estimated_total_ms())
+        if self.slo_p99_ms > 0:
+            est = r.estimated_total_ms()
+            telemetry.gauge(events.GAUGE_FLEET_EST_WAIT_MS).set(
+                round(est, 3))
+            if est > self.slo_p99_ms:
+                return float(est)
+        return None
+
+    def request(self, model: str, rows: Any,
+                timeout: float = 60.0) -> Dict[str, Any]:
+        """One routed round trip; returns the replica's response dict
+        ({"pred", "probs"}), an {"error": ...} dict, or the shed
+        response {"error": "overloaded", "overloaded": True}.  Never
+        raises for replica death or overload — the protocol carries
+        both."""
+        telemetry.counter(events.CTR_FLEET_REQUESTS).inc()
+        telemetry.counter(f"fleet.model.{model}.requests").inc()
+        t0 = time.perf_counter()
+        resp = self._dispatch(model, rows, timeout)
+        if resp.get("overloaded"):
+            telemetry.counter(events.CTR_FLEET_SHED).inc()
+            telemetry.counter(f"fleet.model.{model}.shed").inc()
+        elif "error" in resp:
+            telemetry.counter(events.CTR_FLEET_REQUEST_ERRORS).inc()
+            telemetry.counter(f"fleet.model.{model}.errors").inc()
+        else:
+            dt = time.perf_counter() - t0
+            telemetry.histogram(
+                events.HIST_FLEET_REQUEST_SECONDS).record(dt)
+            telemetry.histogram(
+                f"fleet.model.{model}.request_seconds").record(dt)
+            self._maybe_mirror(model, rows, timeout)
+        return resp
+
+    def _dispatch(self, model: str, rows: Any,
+                  timeout: float) -> Dict[str, Any]:
+        r = self._pick(model)
+        if r is None:
+            return {"error": "no healthy replica", "model": model}
+        est = self._shed(r)
+        if est is not None:
+            return {"error": "overloaded", "overloaded": True,
+                    "model": model, "est_ms": round(est, 2)}
+        tried: Tuple[Replica, ...] = ()
+        for attempt in (0, 1):
+            cur = r
+            with self._lock:
+                self._routed[cur.idx] += 1
+            cur.acquire()
+            telemetry.gauge(events.GAUGE_FLEET_INFLIGHT).set(
+                self.inflight_total())
+            try:
+                return cur.client.wait_for(
+                    cur.client.submit(model, rows), timeout)
+            except ReplicaDied:
+                # the monitor will respawn it; this request retries
+                # ONCE on a healthy peer (idempotent inference) — the
+                # admission gate is not re-run, the request was
+                # already accepted
+                cur.mark_dead()
+                tried = tried + (cur,)
+                if attempt == 0:
+                    telemetry.counter(events.CTR_FLEET_RETRIES).inc()
+                    peer = self._pick(model, exclude=tried)
+                    if peer is None:
+                        return {"error": "replica died and no "
+                                         "healthy peer",
+                                "model": model}
+                    r = peer
+            except TimeoutError:
+                return {"error": f"timeout after {timeout}s",
+                        "model": model}
+            finally:
+                cur.release()
+        return {"error": "replica died twice", "model": model}
+
+    def _maybe_mirror(self, primary: str, rows: Any,
+                      timeout: float) -> None:
+        """Mirror a deterministic sampled fraction of ``primary``'s
+        admitted traffic to each of its canaries, asynchronously (the
+        caller's latency never carries the mirror; the reader thread
+        records the canary-side telemetry)."""
+        pairs = self._mirrors_by_primary.get(primary)
+        if not pairs:
+            return
+        for cname, frac in pairs:
+            with self._lock:
+                acc = self._mirror_acc.get(cname, 0.0) + frac
+                fire = acc >= 1.0
+                self._mirror_acc[cname] = acc - 1.0 if fire else acc
+            if not fire:
+                continue
+            r = self._pick(cname)
+            if r is None:
+                continue
+            telemetry.counter(events.CTR_FLEET_MIRRORED).inc()
+            telemetry.counter(f"fleet.model.{cname}.requests").inc()
+            telemetry.counter(f"fleet.model.{cname}.mirrored").inc()
+            t0 = time.perf_counter()
+            r.acquire()
+            try:
+                jid = r.client.submit(cname, rows)
+            except ReplicaDied:
+                r.release()
+                r.mark_dead()
+                telemetry.counter(
+                    f"fleet.model.{cname}.errors").inc()
+                continue
+
+            def _collect(msg, err, r=r, cname=cname, t0=t0):
+                r.release()
+                if err is not None or (msg and "error" in msg):
+                    telemetry.counter(
+                        f"fleet.model.{cname}.errors").inc()
+                else:
+                    telemetry.histogram(
+                        f"fleet.model.{cname}.request_seconds"
+                    ).record(time.perf_counter() - t0)
+
+            r.client.collect_async(jid, _collect)
+
+    # -- introspection -------------------------------------------------
+
+    def routed_counts(self) -> List[int]:
+        """Requests routed per replica index (request spreading)."""
+        with self._lock:
+            return list(self._routed)
+
+    def inflight_total(self) -> int:
+        return sum(r.inflight for r in self.replicas)
+
+    def replica_stats(self, timeout: float = 30.0) \
+            -> List[Optional[Dict[str, Any]]]:
+        """Each healthy replica's live telemetry snapshot (None for a
+        dead slot) — the bench's per-replica recompile audit."""
+        out: List[Optional[Dict[str, Any]]] = []
+        for r in self.replicas:
+            if r.healthy and r.client is not None:
+                try:
+                    out.append(r.client.stats(timeout=timeout))
+                    continue
+                except (ReplicaDied, TimeoutError):
+                    pass
+            out.append(None)
+        return out
+
+    def fleet_status(self) -> Dict[str, Any]:
+        """One JSON-ready view of the fleet (the CLI's op=fleet)."""
+        return {
+            "replicas": [
+                {"replica": r.idx, "pid": r.pid,
+                 "healthy": r.healthy, "inflight": r.inflight,
+                 "routed": self.routed_counts()[r.idx],
+                 "deaths": r.deaths,
+                 "ema_dispatch_ms": round(
+                     1000 * r.ema_dispatch_s, 3)
+                 if r.ema_dispatch_s else None}
+                for r in self.replicas],
+            "placement": self.placement,
+            "canaries": {c: {"of": p, "fraction": f}
+                         for c, (p, f) in self.canaries.items()},
+            "slo_p99_ms": self.slo_p99_ms,
+            "max_inflight": self.max_inflight,
+        }
+
+    # -- teardown ------------------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait for every in-flight request to resolve."""
+        deadline = time.monotonic() + timeout
+        while self.inflight_total() > 0:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+        return True
+
+    def close(self, kill: bool = False, reason: Optional[str] = None,
+              code: int = 0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.fleet.close(kill=kill)
+        telemetry.event(events.EV_FLEET_SHUTDOWN,
+                        routed=self.routed_counts(), reason=reason,
+                        code=code)
+        telemetry.flush()
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- the CLI front end -------------------------------------------------
+
+def parse_canary(spec: str) -> Tuple[str, str, Optional[float]]:
+    """``CNAME=PRIMARY[:FRACTION]`` -> (cname, primary, fraction)."""
+    cname, _, rest = spec.partition("=")
+    primary, _, frac_s = rest.partition(":")
+    if not cname or not primary:
+        raise ValueError(
+            f"bad --canary spec {spec!r} (want "
+            f"CANARY=PRIMARY[:FRACTION])")
+    frac = None
+    if frac_s:
+        frac = float(frac_s)
+    return cname, primary, frac
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="veles_tpu --serve-fleet",
+        description="Swarm: SLO-aware fleet router over N Hive "
+                    "replicas")
+    p.add_argument("replicas", type=int,
+                   help="replica count (each is one --serve-models "
+                        "subprocess)")
+    p.add_argument("models", nargs="+", metavar="NAME=PKG",
+                   help="model name = Forge ensemble package path; "
+                        "DECLARATION ORDER is the placement hotness "
+                        "order")
+    p.add_argument("-b", "--backend", default="auto")
+    p.add_argument("--canary", action="append", default=[],
+                   metavar="CNAME=PRIMARY[:FRACTION]",
+                   help="register model CNAME as canary-of:PRIMARY, "
+                        "mirroring FRACTION of PRIMARY's traffic "
+                        "(default $VELES_FLEET_CANARY_FRACTION)")
+    p.add_argument("--hot", action="append", default=None,
+                   metavar="NAME",
+                   help="override the placement hot set (repeatable); "
+                        "hot models replicate on every replica")
+    p.add_argument("--max-batch", type=int,
+                   default=int(knobs.get(knobs.SERVE_MAX_BATCH)))
+    p.add_argument("--max-wait-ms", type=float,
+                   default=float(knobs.get(knobs.SERVE_MAX_WAIT_MS)))
+    p.add_argument("--hbm-budget", type=int, default=0,
+                   help="per-replica residency budget override")
+    p.add_argument("--slo-p99-ms", type=float,
+                   default=float(knobs.get(knobs.FLEET_SLO_P99_MS)),
+                   help="admission-control SLO target "
+                        "($VELES_FLEET_SLO_P99_MS; 0 disables "
+                        "shedding)")
+    p.add_argument("--max-inflight", type=int,
+                   default=int(knobs.get(knobs.FLEET_MAX_INFLIGHT)),
+                   help="per-replica in-flight bound "
+                        "($VELES_FLEET_MAX_INFLIGHT)")
+    p.add_argument("--heartbeat-every", type=float,
+                   default=float(knobs.get(knobs.HEARTBEAT_EVERY)))
+    p.add_argument("--metrics-dir", default=None,
+                   help="fleet Sightline dir; each replica writes "
+                        "into replica-<i>/ under it")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from concurrent.futures import ThreadPoolExecutor
+
+    from veles_tpu.logger import setup_logging
+
+    args = build_parser().parse_args(argv)
+    setup_logging(10 if args.verbose else 20)
+    if args.replicas < 1:
+        print(f"--serve-fleet: replica count must be >= 1 "
+              f"(got {args.replicas})", file=sys.stderr)
+        return 2
+    specs: Dict[str, str] = {}
+    for spec in args.models:
+        name, _, path = spec.partition("=")
+        if not name or not path:
+            print(f"--serve-fleet: bad model spec {spec!r} "
+                  f"(want NAME=PACKAGE.vpkg)", file=sys.stderr)
+            return 2
+        if not os.path.isfile(path):
+            print(f"--serve-fleet: no such package {path!r}",
+                  file=sys.stderr)
+            return 2
+        specs[name] = path
+    canaries: Dict[str, Tuple[str, Optional[float]]] = {}
+    try:
+        for cspec in args.canary:
+            cname, primary, frac = parse_canary(cspec)
+            canaries[cname] = (primary, frac)
+        router = FleetRouter(
+            specs, args.replicas, backend=args.backend,
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            hbm_budget=args.hbm_budget or None,
+            heartbeat_every=args.heartbeat_every,
+            metrics_dir=args.metrics_dir,
+            canaries=canaries,
+            placement=PlacementPolicy(
+                budget_bytes=args.hbm_budget or None,
+                hot=set(args.hot) if args.hot else None),
+            slo_p99_ms=args.slo_p99_ms,
+            max_inflight=args.max_inflight)
+    except (ValueError, RuntimeError) as e:
+        print(f"--serve-fleet: {e}", file=sys.stderr)
+        return 2
+
+    emit_lock = threading.Lock()
+
+    def emit(obj: Dict[str, Any]) -> None:
+        with emit_lock:
+            print(json.dumps(obj), flush=True)
+
+    emit({"ready": True, "pid": os.getpid(),
+          "fleet": args.replicas,
+          "replica_pids": [r.pid for r in router.replicas],
+          "models": router.hello_models,
+          "placement": router.placement,
+          "canaries": {c: {"of": p, "fraction": f}
+                       for c, (p, f) in router.canaries.items()},
+          "slo_p99_ms": router.slo_p99_ms,
+          "max_inflight": router.max_inflight})
+    telemetry.flush()
+
+    stop = {"signal": None}
+    stop_event = threading.Event()
+
+    def _on_term(signum, frame) -> None:
+        if stop["signal"] is not None:
+            os.write(2, b"fleet: second signal - hard exit\n")
+            os._exit(EXIT_PREEMPTED)
+        stop["signal"] = signum
+        stop_event.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGINT, _on_term)
+    except (ValueError, OSError):   # embedded / non-main thread
+        pass
+
+    hb_stop = threading.Event()
+
+    def _hb_loop() -> None:
+        n = 0
+        while not hb_stop.wait(args.heartbeat_every):
+            emit({"hb": n, "pid": os.getpid()})
+            n += 1
+
+    if args.heartbeat_every > 0:
+        threading.Thread(target=_hb_loop, daemon=True,
+                         name="fleet-heartbeat").start()
+
+    jobs: "queue.Queue[Optional[str]]" = queue.Queue()
+
+    def _read_stdin() -> None:
+        for line in sys.stdin:
+            jobs.put(line)
+        jobs.put(None)   # EOF
+
+    threading.Thread(target=_read_stdin, daemon=True,
+                     name="fleet-stdin").start()
+
+    pool = ThreadPoolExecutor(
+        max_workers=min(64, 8 * args.replicas),
+        thread_name_prefix="fleet-route")
+
+    def handle(line: str) -> bool:
+        """One request line; returns False when the loop should end."""
+        line = line.strip()
+        if not line:
+            return True
+        try:
+            job = json.loads(line)
+        except ValueError:
+            emit({"error": f"bad request line: {line[:120]!r}"})
+            return True
+        op = job.get("op")
+        if op == "shutdown":
+            return False
+        if op == "stats":
+            emit({"id": job.get("id"), "stats": telemetry.snapshot()})
+            return True
+        if op == "fleet":
+            emit({"id": job.get("id"),
+                  "fleet": router.fleet_status()})
+            return True
+        jid = job.get("id")
+        try:
+            model = job["model"]
+            rows = np.asarray(job["rows"], np.float32)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — a bad request
+            emit({"id": jid, "error": f"{type(e).__name__}: {e}"})
+            return True
+
+        def _route(jid=jid, model=model, rows=rows) -> None:
+            resp = router.request(model, rows)
+            resp = dict(resp)
+            resp["id"] = jid
+            emit(resp)
+
+        pool.submit(_route)
+        return True
+
+    rc = 0
+    while not stop_event.is_set():
+        try:
+            line = jobs.get(timeout=0.2)
+        except queue.Empty:
+            continue
+        if line is None:      # stdin closed: the parent went away
+            break
+        if not handle(line):
+            break
+
+    # -- drain: accept what is already on the wire, then let every
+    # in-flight routed request resolve before the replicas go down
+    if stop_event.is_set():
+        time.sleep(0.3)
+    n_late = 0
+    while True:
+        try:
+            line = jobs.get_nowait()
+        except queue.Empty:
+            break
+        if line is None:
+            continue
+        n_late += 1
+        handle(line)
+    pool.shutdown(wait=True)
+    drained = router.drain()
+    telemetry.event(events.EV_FLEET_DRAIN, late_requests=n_late,
+                    complete=bool(drained))
+    reason = None
+    if stop["signal"] is not None:
+        try:
+            reason = signal.Signals(stop["signal"]).name
+        except ValueError:
+            reason = f"sig{stop['signal']}"
+        rc = EXIT_PREEMPTED
+    router.close(reason=reason, code=rc)
+    hb_stop.set()
+    telemetry.flush()
+    if rc:
+        # the Phoenix preemption contract: a supervised fleet resumes
+        # with warm replica install dirs
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

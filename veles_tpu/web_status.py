@@ -101,21 +101,32 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _send_metrics_json(self) -> None:
-        from veles_tpu.obs import load_dir
+        from veles_tpu.obs import (fleet_model_rows, fleet_rows,
+                                   load_dir)
         reg, snaps, journals, events = load_dir(self.metrics_dir)
         merged = reg.snapshot()
         merged["snapshots"] = len(snaps)
         merged["journal_events"] = len(events)
+        replicas = fleet_rows(self.metrics_dir)
+        if replicas:
+            merged["fleet"] = {
+                "replicas": replicas,
+                "models": fleet_model_rows(reg, events)}
         self._send(200, json.dumps(merged).encode(),
                    "application/json")
 
     def _send_metrics_page(self) -> None:
         import html
 
-        from veles_tpu.obs import load_dir, render
+        from veles_tpu.obs import load_dir, render, render_fleet
         reg, snaps, journals, events = load_dir(self.metrics_dir)
         report = render(self.metrics_dir, reg, snaps, journals,
                         events)
+        # a fleet dir (replica-* child dirs) gets the per-replica /
+        # per-model console on top — the dashboard IS the fleet view
+        fleet = render_fleet(self.metrics_dir)
+        if fleet:
+            report = fleet + "\n\n" + report
         self._send(200, _METRICS_PAGE.format(
             mdir=html.escape(self.metrics_dir),
             report=html.escape(report)).encode())
