@@ -1045,7 +1045,8 @@ def fleet_metric(phase):
                             sheds[0] += 1
                         time.sleep(shed_backoff_s)
                     elif "error" in res:
-                        if "timeout" in res["error"]:
+                        if res.get("timeout") \
+                                or "timeout" in res["error"]:
                             timeouts[0] += 1
                         else:
                             errors[0] += 1
@@ -1187,6 +1188,74 @@ def fleet_metric(phase):
         finally:
             router.close(kill=True)
 
+        # -- gray failure: one SLOW replica, sentinel armed ------------
+        # (ISSUE 12 acceptance: with one replica injected slow, fleet
+        # p99 <= 1.5x the healthy-fleet p99 — hedges bridge the
+        # detection window, ejection removes the outlier, probes
+        # reinstate it once the fault budget exhausts)
+        gray_seconds = float(os.environ.get(
+            "BENCH_FLEET_GRAY_SLOW_SEC", "1.5"))
+        gray_times = int(os.environ.get("BENCH_FLEET_GRAY_TIMES",
+                                        "12"))
+        phase(f"fleet: gray drill — replica 0 slow "
+              f"({gray_seconds}s/dispatch, {gray_times} firings)")
+        hedges0 = telemetry.counter(events.CTR_FLEET_HEDGES).value
+        wins0 = telemetry.counter(events.CTR_FLEET_HEDGE_WINS).value
+        eject0 = telemetry.counter(events.CTR_FLEET_EJECTIONS).value
+        reinst0 = telemetry.counter(
+            events.CTR_FLEET_REINSTATEMENTS).value
+        stale0 = telemetry.counter(
+            events.CTR_FLEET_STALE_RESPONSES).value
+        req0 = telemetry.counter(events.CTR_FLEET_REQUESTS).value
+        router = FleetRouter(
+            {"primary": pkg_main}, n_replicas=2, backend="cpu",
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            metrics_dir=os.path.join(tmp, "metrics-gray"), cwd=here,
+            env={"VELES_FAULTS": ""},
+            env_overrides={0: {"VELES_FAULTS":
+                               f"hive.slow_dispatch@label=primary"
+                               f"&times={gray_times}"
+                               f"&seconds={gray_seconds}"}},
+            deadline_ms=8000.0, hedge_min_ms=50.0, hedge_budget=1.0,
+            probe_interval=0.2, probe_ok=3, probe_backoff_cap=0.5)
+        try:
+            ramp = min(1.5, window / 2)
+            phase(f"fleet: gray window ({max(2, clients_per // 2)} "
+                  f"clients, {ramp:.1f}s ramp discarded)")
+            lat, _g_sheds, g_tmo, g_errs = closed_loop_window(
+                router, max(2, clients_per // 2), window + ramp,
+                ramp_s=ramp)
+            gray_p99 = 1000 * float(np.percentile(lat, 99)) \
+                if lat else None
+            gray_hedges = telemetry.counter(
+                events.CTR_FLEET_HEDGES).value - hedges0
+            gray_requests = telemetry.counter(
+                events.CTR_FLEET_REQUESTS).value - req0
+            gray_ejections = telemetry.counter(
+                events.CTR_FLEET_EJECTIONS).value - eject0
+            phase(f"fleet: gray -> p99 "
+                  f"{gray_p99 and round(gray_p99, 1)}ms vs healthy "
+                  f"{unloaded_p99:.1f}ms, {gray_hedges} hedges, "
+                  f"{gray_ejections} ejections, {g_tmo} timeouts, "
+                  f"{g_errs} errors")
+            # the fault budget exhausts under probing; wait for the
+            # probe/reinstate lifecycle to complete
+            reinstated = False
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if telemetry.counter(
+                        events.CTR_FLEET_REINSTATEMENTS).value \
+                        > reinst0:
+                    reinstated = True
+                    break
+                time.sleep(0.25)
+            gray_status = router.fleet_status()
+            phase(f"fleet: gray replica 0 "
+                  f"{gray_status['replicas'][0]['sentinel']['state']}"
+                  f" (reinstated={reinstated})")
+        finally:
+            router.close(kill=True)
+
         out = {
             "fleet_replica_counts": replica_counts,
             "fleet_qps_by_replicas": {
@@ -1223,6 +1292,26 @@ def fleet_metric(phase):
             "fleet_canary_fraction": canary_fraction,
             "fleet_canary_observed": round(canary_observed, 4)
             if canary_observed is not None else None,
+            "fleet_gray_slow_seconds": gray_seconds,
+            "fleet_gray_fault_times": gray_times,
+            "fleet_gray_requests": int(gray_requests),
+            "fleet_gray_p99_ms": round(gray_p99, 3)
+            if gray_p99 is not None else None,
+            "fleet_gray_p99_ratio": round(gray_p99 / unloaded_p99, 3)
+            if gray_p99 is not None else None,
+            "fleet_gray_hedges": int(gray_hedges),
+            "fleet_gray_hedge_wins": int(telemetry.counter(
+                events.CTR_FLEET_HEDGE_WINS).value - wins0),
+            "fleet_gray_hedge_rate": round(
+                gray_hedges / max(1, gray_requests), 4),
+            "fleet_gray_ejections": int(gray_ejections),
+            "fleet_gray_reinstatements": int(telemetry.counter(
+                events.CTR_FLEET_REINSTATEMENTS).value - reinst0),
+            "fleet_gray_stale_responses": int(telemetry.counter(
+                events.CTR_FLEET_STALE_RESPONSES).value - stale0),
+            "fleet_gray_timeouts": int(g_tmo),
+            "fleet_gray_errors": int(g_errs),
+            "fleet_gray_deadline_ms": 8000.0,
             "fleet_platform": "cpu",
         }
         phase(f"fleet: {out['fleet_qps_1']} qps @1 -> "
@@ -1230,7 +1319,10 @@ def fleet_metric(phase):
               f"{out['fleet_scaling_efficiency']}), spike p99 ratio "
               f"{out['fleet_spike_p99_ratio']}, canary "
               f"{out['fleet_canary_observed']} of "
-              f"{canary_fraction}")
+              f"{canary_fraction}, gray p99 ratio "
+              f"{out['fleet_gray_p99_ratio']} "
+              f"({out['fleet_gray_ejections']} ejected / "
+              f"{out['fleet_gray_reinstatements']} reinstated)")
         return out
     except Exception as e:  # noqa: BLE001 — enrichment only
         print(f"fleet metric failed: {e}", file=sys.stderr)
@@ -1783,6 +1875,20 @@ def main() -> None:
         "fleet_failover_respawned": None,
         "fleet_canary_fraction": None,
         "fleet_canary_observed": None,
+        "fleet_gray_slow_seconds": None,
+        "fleet_gray_fault_times": None,
+        "fleet_gray_requests": None,
+        "fleet_gray_p99_ms": None,
+        "fleet_gray_p99_ratio": None,
+        "fleet_gray_hedges": None,
+        "fleet_gray_hedge_wins": None,
+        "fleet_gray_hedge_rate": None,
+        "fleet_gray_ejections": None,
+        "fleet_gray_reinstatements": None,
+        "fleet_gray_stale_responses": None,
+        "fleet_gray_timeouts": None,
+        "fleet_gray_errors": None,
+        "fleet_gray_deadline_ms": None,
         "fleet_platform": None,
         "conv_roofline_minibatch": None,
         "conv_roofline_layers": None,

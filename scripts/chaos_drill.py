@@ -689,6 +689,237 @@ def drill_supervisor__sigkill_ga_resume():
             "resume_downtime_sec": resumed[-1].get("downtime")}
 
 
+# -- Sentinel drills (fleet gray failures) -----------------------------
+
+_FLEET_WF = """
+from veles_tpu import prng
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+def create_workflow(launcher):
+    prng.seed_all(4242)
+    train, valid, _ = synthetic_classification(
+        64, 16, (6, 6, 1), n_classes=3, seed=5)
+    return StandardWorkflow(
+        loader_factory=lambda w: ArrayLoader(
+            w, train=train, valid=valid, minibatch_size=16,
+            name="loader"),
+        layers=[
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": 12},
+             "<-": {"learning_rate": 0.1}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.1}},
+        ],
+        decision_config={"max_epochs": 2}, name="chaos_fleet_wf")
+"""
+
+
+def _fleet_pkg(d):
+    """One tiny Forge ensemble package + its host oracle (the
+    test_fleet recipe) for the gray-failure fleet drills."""
+    from veles_tpu import prng
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.ensemble.packaging import pack_ensemble
+    from veles_tpu.launcher import load_workflow_module
+
+    wf_path = os.path.join(d, "fleet_wf.py")
+    with open(wf_path, "w") as f:
+        f.write(_FLEET_WF)
+    mod = load_workflow_module(wf_path)
+
+    class FL:
+        workflow = None
+
+    prng.seed_all(11)
+    w = mod.create_workflow(FL())
+    w.initialize(device=NumpyDevice())
+    base = {fw.name: {k: np.asarray(v) for k, v in
+                      fw.gather_params().items()}
+            for fw in w.forwards}
+    rng = np.random.default_rng(11)
+    members = []
+    for _ in range(3):
+        params = {fn: {pn: (a + 0.05 * rng.standard_normal(a.shape)
+                            .astype(np.float32))
+                       for pn, a in p.items()}
+                  for fn, p in base.items()}
+        members.append({"params": params, "valid_error": 0.0,
+                        "seed": 11,
+                        "forward_names": [fw.name
+                                          for fw in w.forwards],
+                        "values": None})
+    pkg = os.path.join(d, "m.vpkg")
+    pack_ensemble(pkg, "m", members, wf_path)
+
+    def oracle(x):
+        acc = None
+        for m in members:
+            out = np.asarray(x, np.float32)
+            for fw in w.forwards:
+                p = {k: np.asarray(v)
+                     for k, v in m["params"][fw.name].items()}
+                out, _ = fw.apply_fwd(p, out, rng=None, train=False)
+            out = np.asarray(out)
+            acc = out if acc is None else acc + out
+        return acc / len(members)
+
+    return pkg, oracle
+
+
+def _gray_fleet(fault, d, **kw):
+    """A REAL 2-replica fleet with replica 0 armed via a per-replica
+    VELES_FAULTS override (replica 1 explicitly disarmed)."""
+    from veles_tpu.serve.router import FleetRouter
+    pkg, oracle = _fleet_pkg(d)
+    defaults = dict(
+        n_replicas=2, backend="cpu", max_batch=16, max_wait_ms=5,
+        metrics_dir=os.path.join(d, "metrics"), cwd=REPO,
+        env={"VELES_FAULTS": ""},
+        env_overrides={0: {"VELES_FAULTS": fault}})
+    defaults.update(kw)
+    return FleetRouter({"m": pkg}, **defaults), oracle
+
+
+def _ctr(name):
+    from veles_tpu import telemetry
+    return telemetry.counter(name).value
+
+
+def drill_hive__slow_dispatch():
+    """The tail-at-scale drill: one replica dispatches at 1.5s while
+    staying alive and heartbeating.  Hedges must bridge the detection
+    window (every answer clean and fast), the sentinel must EJECT the
+    outlier, and — once the fault budget exhausts under probing — the
+    probe/reinstate lifecycle must bring it back."""
+    d = tempfile.mkdtemp(prefix="chaos_gray_slow_")
+    router, oracle = _gray_fleet(
+        "hive.slow_dispatch@label=m&times=6&seconds=1.5", d,
+        deadline_ms=8000, hedge_min_ms=60, hedge_budget=1.0,
+        probe_interval=0.2, probe_ok=2, probe_backoff_cap=0.4)
+    hedges0 = _ctr(events.CTR_FLEET_HEDGES)
+    eject0 = _ctr(events.CTR_FLEET_EJECTIONS)
+    reinst0 = _ctr(events.CTR_FLEET_REINSTATEMENTS)
+    try:
+        x = np.ones((1, 6, 6, 1), np.float32)
+        want = oracle(x)
+        for _ in range(30):
+            r = router.request("m", x, timeout=30)
+            assert "probs" in r, r
+            assert np.abs(np.asarray(r["probs"], np.float32)
+                          - want).max() < 1e-4
+            if _ctr(events.CTR_FLEET_EJECTIONS) > eject0:
+                break
+        assert _ctr(events.CTR_FLEET_HEDGES) > hedges0
+        assert _ctr(events.CTR_FLEET_EJECTIONS) == eject0 + 1
+        # post-ejection p99 is bounded: nothing waits out the stall
+        post = []
+        for _ in range(10):
+            t0 = time.monotonic()
+            assert "probs" in router.request("m", x, timeout=30)
+            post.append(time.monotonic() - t0)
+        assert max(post) < 1.0, post
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline \
+                and _ctr(events.CTR_FLEET_REINSTATEMENTS) <= reinst0:
+            time.sleep(0.25)
+        assert _ctr(events.CTR_FLEET_REINSTATEMENTS) == reinst0 + 1
+        ev = assert_journal_event(events.EV_FLEET_REPLICA_EJECTED)
+        assert ev["replica"] == 0, ev
+        assert_journal_event(events.EV_FLEET_REPLICA_REINSTATED)
+        return {"hedged": _ctr(events.CTR_FLEET_HEDGES) - hedges0,
+                "post_eject_max_ms": round(1000 * max(post), 1),
+                "ejected_and_reinstated": True,
+                "journal_event": events.EV_FLEET_REPLICA_EJECTED}
+    finally:
+        router.close(kill=True)
+
+
+def drill_hive__wedge():
+    """A wedged batcher: requests vanish unanswered while heartbeats
+    and stats keep flowing — invisible to the heartbeat monitor.  The
+    sentinel must detect it (hedge losses), eject it WITHOUT any
+    heartbeat loss, and keep it out (probes are swallowed too)."""
+    d = tempfile.mkdtemp(prefix="chaos_gray_wedge_")
+    router, _oracle = _gray_fleet(
+        "hive.wedge@times=*", d,
+        deadline_ms=5000, hedge_min_ms=60, hedge_budget=1.0,
+        probe_interval=0.25, probe_ok=2, probe_backoff_cap=0.5,
+        heartbeat_every=0.2)
+    eject0 = _ctr(events.CTR_FLEET_EJECTIONS)
+    probe_fail0 = _ctr(events.CTR_FLEET_PROBES_FAILED)
+    try:
+        x = np.ones((1, 6, 6, 1), np.float32)
+        for _ in range(25):
+            assert "probs" in router.request("m", x, timeout=30)
+            if _ctr(events.CTR_FLEET_EJECTIONS) > eject0:
+                break
+        assert _ctr(events.CTR_FLEET_EJECTIONS) == eject0 + 1
+        # detection WITHOUT heartbeat loss: the monitor saw no death
+        assert router.replicas[0].deaths == 0
+        assert router.replicas[0].healthy
+        assert router.replicas[0].client.heartbeats > 0
+        # the wedged replica can never pass its canary probe
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline \
+                and _ctr(events.CTR_FLEET_PROBES_FAILED) \
+                <= probe_fail0:
+            time.sleep(0.1)
+        assert _ctr(events.CTR_FLEET_PROBES_FAILED) > probe_fail0
+        st = router.sentinel.status(router.replicas[0])
+        assert st["state"] in ("ejected", "probing"), st
+        ev = assert_journal_event(events.EV_FLEET_REPLICA_EJECTED)
+        assert ev["replica"] == 0, ev
+        return {"heartbeats_flowed": router.replicas[0]
+                .client.heartbeats,
+                "deaths": 0, "stays_ejected": True,
+                "journal_event": events.EV_FLEET_REPLICA_EJECTED}
+    finally:
+        router.close(kill=True)
+
+
+def drill_hive__garbage_response():
+    """Corrupt responses: a replica garbles every probability payload
+    AFTER its crc echo was computed from the clean one.  The router's
+    integrity check must strike + retry on the peer so ZERO corrupt
+    answers reach a client (oracle parity held), and the replica must
+    eject and stay out (its probes read garbage too)."""
+    d = tempfile.mkdtemp(prefix="chaos_gray_garbage_")
+    router, oracle = _gray_fleet(
+        "hive.garbage_response@times=*", d,
+        deadline_ms=8000, hedge_budget=0.0,
+        probe_interval=0.25, probe_ok=2, probe_backoff_cap=0.5)
+    strikes0 = _ctr(events.CTR_FLEET_INTEGRITY_STRIKES)
+    eject0 = _ctr(events.CTR_FLEET_EJECTIONS)
+    try:
+        x = np.ones((2, 6, 6, 1), np.float32)
+        want = oracle(x)
+        corrupt_served = 0
+        for _ in range(20):
+            r = router.request("m", x, timeout=30)
+            assert "probs" in r, r
+            if np.abs(np.asarray(r["probs"], np.float32)
+                      - want).max() >= 1e-4:
+                corrupt_served += 1
+        assert corrupt_served == 0, \
+            f"{corrupt_served} corrupt answers reached a client"
+        assert _ctr(events.CTR_FLEET_INTEGRITY_STRIKES) > strikes0
+        assert _ctr(events.CTR_FLEET_EJECTIONS) == eject0 + 1
+        st = router.sentinel.status(router.replicas[0])
+        assert st["state"] in ("ejected", "probing"), st
+        assert st["reinstatements"] == 0, st
+        ev = assert_journal_event(events.EV_FLEET_REPLICA_EJECTED)
+        assert ev["replica"] == 0, ev
+        return {"corrupt_served": 0,
+                "integrity_strikes":
+                    _ctr(events.CTR_FLEET_INTEGRITY_STRIKES)
+                    - strikes0,
+                "journal_event": events.EV_FLEET_REPLICA_EJECTED}
+    finally:
+        router.close(kill=True)
+
+
 DRILLS = [
     drill_snapshot__torn_write,
     drill_checkpoint__corrupt,
@@ -699,6 +930,9 @@ DRILLS = [
     drill_multihost__peer_exit,
     drill_preempt__sigterm_resume,
     drill_supervisor__sigkill_ga_resume,
+    drill_hive__slow_dispatch,
+    drill_hive__wedge,
+    drill_hive__garbage_response,
 ]
 
 

@@ -362,6 +362,189 @@ class TestFleetFailover:
             router.close(kill=True)
 
 
+class TestFleetGrayFailures:
+    """ISSUE 12 (Sentinel): gray failures — a replica that is slow,
+    wedged, or corrupt while remaining process-alive and heartbeating.
+    One replica of a REAL 2-replica fleet is armed via a per-replica
+    VELES_FAULTS override; the router's deadline/hedge/integrity
+    machinery must keep every client answer clean and bounded, eject
+    the sick replica from routing, and (once the fault budget
+    exhausts) reinstate it after consecutive clean probes."""
+
+    def _gray_router(self, packages, tmp_path_factory, fault, name,
+                     **kw):
+        from veles_tpu.serve.router import FleetRouter
+        mdir = str(tmp_path_factory.mktemp(name))
+        defaults = dict(
+            n_replicas=2, backend="cpu", max_batch=16, max_wait_ms=5,
+            metrics_dir=mdir, cwd=REPO,
+            env_overrides={0: {"VELES_FAULTS": fault}})
+        defaults.update(kw)
+        r = FleetRouter({"alpha": packages["alpha"]["pkg"]},
+                        **defaults)
+        r.metrics_dir_path = mdir
+        return r
+
+    @staticmethod
+    def _ctr(name):
+        from veles_tpu import telemetry
+        return telemetry.counter(name).value
+
+    def test_slow_replica_hedged_ejected_then_reinstated(
+            self, packages, tmp_path_factory):
+        # replica 0's every dispatch stalls 1.5s for the first 6
+        # firings (requests AND probes consume the budget), then the
+        # fault exhausts and the replica is genuinely healthy again
+        router = self._gray_router(
+            packages, tmp_path_factory,
+            "hive.slow_dispatch@label=alpha&times=6&seconds=1.5",
+            "fleet_gray_slow",
+            deadline_ms=8000, hedge_min_ms=60, hedge_budget=1.0,
+            probe_interval=0.2, probe_ok=2, probe_backoff_cap=0.4)
+        try:
+            hedges0 = self._ctr("fleet.hedge.issued")
+            wins0 = self._ctr("fleet.hedge.wins")
+            eject0 = self._ctr("fleet.eject.total")
+            x = np.ones((1, 6, 6, 1), np.float32)
+            want = _host_oracle(packages["alpha"], x)
+            lats = []
+            for _ in range(30):
+                t0 = time.perf_counter()
+                r = router.request("alpha", x, timeout=30)
+                lats.append(time.perf_counter() - t0)
+                # EVERY answer is clean despite the slow replica: the
+                # hedge (or post-ejection routing) covered it
+                assert "probs" in r, r
+                np.testing.assert_allclose(
+                    np.asarray(r["probs"], np.float32), want,
+                    atol=1e-4)
+                if self._ctr("fleet.eject.total") > eject0:
+                    break
+            assert self._ctr("fleet.hedge.issued") > hedges0
+            assert self._ctr("fleet.hedge.wins") > wins0
+            assert self._ctr("fleet.eject.total") == eject0 + 1
+            st = router.sentinel.status(router.replicas[0])
+            assert st["state"] in ("ejected", "probing"), st
+            assert st["strikes"].get("hedge_loss", 0) >= 1, st
+            # post-ejection traffic routes around the sick replica and
+            # p99 stays bounded: nothing waits out the 1.5s stall
+            post = []
+            for _ in range(10):
+                t0 = time.perf_counter()
+                r = router.request("alpha", x, timeout=30)
+                assert "probs" in r, r
+                post.append(time.perf_counter() - t0)
+            assert max(post) < 1.0, post
+            # the fault budget exhausts under probing; PROBE_OK=2
+            # consecutive clean probes reinstate the replica
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                st = router.sentinel.status(router.replicas[0])
+                if st["state"] == "healthy" \
+                        and st["reinstatements"] >= 1:
+                    break
+                time.sleep(0.2)
+            assert st["state"] == "healthy", st
+            assert st["reinstatements"] >= 1, st
+            assert self._ctr("fleet.eject.reinstated_total") >= 1
+            # reinstated means ROUTABLE again: the fleet serves fine
+            assert "probs" in router.request("alpha", x, timeout=30)
+            # the hedge losers' late answers were dropped as stale,
+            # never leaked into other waiters (all answers were clean)
+            assert self._ctr("fleet.stale_response") >= 1
+        finally:
+            router.close(kill=True)
+
+    def test_wedged_replica_detected_without_heartbeat_loss(
+            self, packages, tmp_path_factory):
+        # replica 0 swallows EVERY model request forever while its
+        # heartbeats and stats keep flowing — invisible to the
+        # heartbeat-deadline monitor, caught only by the sentinel
+        router = self._gray_router(
+            packages, tmp_path_factory, "hive.wedge@times=*",
+            "fleet_gray_wedge",
+            deadline_ms=5000, hedge_min_ms=60, hedge_budget=1.0,
+            probe_interval=0.25, probe_ok=2, heartbeat_every=0.2)
+        try:
+            eject0 = self._ctr("fleet.eject.total")
+            probe_fail0 = self._ctr("fleet.probe.fail")
+            x = np.ones((1, 6, 6, 1), np.float32)
+            for _ in range(25):
+                # every request still answers (hedged onto the peer)
+                assert "probs" in router.request("alpha", x,
+                                                 timeout=30)
+                if self._ctr("fleet.eject.total") > eject0:
+                    break
+            assert self._ctr("fleet.eject.total") == eject0 + 1
+            st = router.sentinel.status(router.replicas[0])
+            assert st["state"] in ("ejected", "probing"), st
+            # DETECTION WITHOUT HEARTBEAT LOSS: the monitor never saw
+            # a death (no EOF, no silence) — the process is alive and
+            # chatting the whole time
+            assert router.replicas[0].deaths == 0
+            assert router.replicas[0].healthy
+            assert router.replicas[0].client.heartbeats > 0
+            # probes are swallowed too: the wedged replica can NEVER
+            # pass its canary, so it stays out of rotation
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline \
+                    and self._ctr("fleet.probe.fail") <= probe_fail0:
+                time.sleep(0.1)
+            assert self._ctr("fleet.probe.fail") > probe_fail0
+            st = router.sentinel.status(router.replicas[0])
+            assert st["state"] in ("ejected", "probing"), st
+        finally:
+            router.close(kill=True)
+
+    def test_garbage_response_never_reaches_a_client(
+            self, packages, tmp_path_factory):
+        # replica 0 corrupts every probability payload AFTER the crc
+        # echo was computed from the clean one: the router's integrity
+        # check must strike + retry on the peer so oracle parity holds
+        router = self._gray_router(
+            packages, tmp_path_factory,
+            "hive.garbage_response@times=*", "fleet_gray_garbage",
+            deadline_ms=8000, hedge_budget=0.0,
+            probe_interval=0.25, probe_ok=2)
+        try:
+            strikes0 = self._ctr("fleet.integrity_strikes")
+            retries0 = self._ctr("fleet.retries")
+            eject0 = self._ctr("fleet.eject.total")
+            x = np.ones((2, 6, 6, 1), np.float32)
+            want = _host_oracle(packages["alpha"], x)
+            for _ in range(20):
+                r = router.request("alpha", x, timeout=30)
+                # ZERO corrupt answers reach a client — every response
+                # is oracle-exact (the corrupt ones were caught by the
+                # checksum echo and retried on the healthy peer)
+                assert "probs" in r, r
+                np.testing.assert_allclose(
+                    np.asarray(r["probs"], np.float32), want,
+                    atol=1e-4)
+            assert self._ctr("fleet.integrity_strikes") > strikes0
+            assert self._ctr("fleet.retries") > retries0
+            assert self._ctr("fleet.eject.total") == eject0 + 1
+            st = router.sentinel.status(router.replicas[0])
+            assert st["state"] in ("ejected", "probing"), st
+            assert st["strikes"].get("integrity", 0) >= 2, st
+            # probes read garbage too: reinstatement is impossible
+            # while the fault is armed
+            assert st["reinstatements"] == 0, st
+            # the sentinel overlay reaches the operator surfaces
+            fs = router.fleet_status()
+            assert fs["replicas"][0]["sentinel"]["state"] in (
+                "ejected", "probing")
+            from veles_tpu import telemetry
+            telemetry.flush()
+            from veles_tpu.obs import fleet_rows
+            rows = fleet_rows(router.metrics_dir_path)
+            assert rows[0]["state"] in ("ejected", "probing"), rows
+            assert rows[0]["health_score"] is not None
+            assert rows[1]["state"] == "healthy", rows
+        finally:
+            router.close(kill=True)
+
+
 class TestFleetCliProtocol:
     """The real ``python -m veles_tpu --serve-fleet N`` front end: the
     hello line carries fleet/placement/canary state, requests answer

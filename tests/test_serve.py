@@ -228,6 +228,37 @@ class TestMicroBatcher:
         assert all(f.done() for f in futs)
         b.close()
 
+    def test_expired_request_dropped_before_dispatch(self):
+        """Sentinel deadline semantics: a request whose deadline_ms
+        passed while it was still fully queued is dropped with
+        DeadlineExpired and NEVER dispatched — computing an answer
+        nobody is waiting for would steal the window from requests
+        that can still make their deadline."""
+        from veles_tpu import telemetry
+        from veles_tpu.serve.batcher import DeadlineExpired
+        dispatched = []
+
+        def dispatch(xb):
+            dispatched.append(len(xb))
+            return xb
+
+        dropped0 = telemetry.counter("serve.deadline_dropped").value
+        b = self._batcher(dispatch, max_batch=4, max_wait_s=0.1)
+        # already expired at submit: must never reach the dispatcher
+        f_dead = b.submit(np.full((1, 2), 7.0, np.float32),
+                          deadline_ms=time.time() * 1000.0 - 50.0)
+        with pytest.raises(DeadlineExpired):
+            f_dead.result(timeout=5)
+        # a live-deadline request on the same batcher still answers
+        f_ok = b.submit(np.ones((1, 2), np.float32),
+                        deadline_ms=time.time() * 1000.0 + 30000.0)
+        assert f_ok.result(timeout=5).shape == (1, 2)
+        assert telemetry.counter(
+            "serve.deadline_dropped").value == dropped0 + 1
+        # the expired request's payload (7.0) never dispatched
+        b.drain(timeout=5)
+        b.close()
+
 
 class TestHiveRoundTrip:
     """(a) oracle parity under N concurrent clients and (b) request
@@ -447,6 +478,82 @@ class TestReplicaDeathClient:
             assert type(err).__name__ == "ReplicaDied"
         finally:
             c.close(kill=True)
+
+
+class TestClientCancelStale:
+    """ISSUE 12 satellite: HiveClient.cancel(jid) (the hedge-loser /
+    timeout-cleanup path) and the stale/unknown-jid drop — a late
+    response must never leak into another waiter, and it is COUNTED
+    (`fleet.stale_response`) instead of silently ignored."""
+
+    @pytest.fixture(scope="class")
+    def client(self, packages, tmp_path_factory):
+        from veles_tpu.serve.client import HiveClient
+        # a long coalescing window opens a deterministic gap between
+        # submit and response in which to cancel
+        c = HiveClient({"alpha": packages["alpha"]["pkg"]},
+                       backend="cpu", max_batch=8, max_wait_ms=400,
+                       cwd=REPO)
+        yield c
+        c.close()
+
+    def _stale(self):
+        from veles_tpu import telemetry
+        return telemetry.counter("fleet.stale_response").value
+
+    def test_cancel_pending_drops_late_response_counted(self, client):
+        stale0 = self._stale()
+        jid = client.submit("alpha", np.ones((1, 6, 6, 1), np.float32))
+        assert client.cancel(jid) is False   # still pending
+        # the response lands after the 400ms window — dropped + counted
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline \
+                and self._stale() < stale0 + 1:
+            time.sleep(0.05)
+        assert self._stale() == stale0 + 1
+        with client._cond:
+            assert jid not in client._results   # nothing leaked
+
+    def test_cancel_after_arrival_drops_and_returns_true(self, client):
+        jid = client.submit("alpha", np.ones((1, 6, 6, 1), np.float32))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            with client._cond:
+                if jid in client._results:
+                    break
+            time.sleep(0.05)
+        assert client.cancel(jid) is True
+        with client._cond:
+            assert jid not in client._results
+
+    def test_unknown_jid_response_counted_stale(self, client):
+        stale0 = self._stale()
+        # an id this client never drew: the hive answers it, the
+        # reader must drop it as stale instead of parking it forever
+        client._send({"id": 10 ** 9, "model": "alpha",
+                      "rows": np.ones((1, 6, 6, 1),
+                                      np.float32).tolist()})
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline \
+                and self._stale() < stale0 + 1:
+            time.sleep(0.05)
+        assert self._stale() == stale0 + 1
+
+    def test_deadline_rides_the_wire(self, client):
+        # a deadline shorter than the coalescing window: the hive's
+        # batcher drops the queued request and answers expired=True
+        resp = client.wait_for(
+            client.submit("alpha", np.ones((1, 6, 6, 1), np.float32),
+                          deadline_ms=time.time() * 1000.0 + 60.0),
+            timeout=30)
+        assert resp.get("expired") is True, resp
+        assert "error" in resp
+        # with a generous deadline the same request answers normally
+        resp = client.wait_for(
+            client.submit("alpha", np.ones((1, 6, 6, 1), np.float32),
+                          deadline_ms=time.time() * 1000.0 + 30000.0),
+            timeout=30)
+        assert "probs" in resp, resp
 
 
 class TestEngineSubmitApi:

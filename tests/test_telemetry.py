@@ -154,6 +154,37 @@ class TestHistogramQuantiles:
         assert h.quantile(1.0) == 1e12
         assert h.count == 2
 
+    def test_delta_quantile_tracks_the_window_not_history(self):
+        """The sentinel's windowed read: the quantile of ONLY the
+        samples since the snapshot — a load shift must show up
+        immediately even against a long contrary history."""
+        rng = np.random.default_rng(11)
+        h = telemetry.Histogram("w")
+        fast = rng.lognormal(np.log(0.005), 0.3, 10000)   # ~5ms era
+        for x in fast:
+            h.record(x)
+        base = h.snapshot_buckets()
+        slow = rng.lognormal(np.log(0.050), 0.3, 2000)    # ~50ms era
+        for x in slow:
+            h.record(x)
+        got = h.delta_quantile(base, 0.95, min_count=20)
+        want = float(np.quantile(slow, 0.95))
+        # the windowed p95 reads the NEW era...
+        assert abs(got - want) / want < 0.08, (got, want)
+        # ...while the cumulative p95 is still dragged down by the
+        # 10k-sample fast history (the lag the window exists to fix)
+        assert h.quantile(0.95) < 0.8 * want
+        # an empty/thin window reports None instead of a stale number
+        base2 = h.snapshot_buckets()
+        assert h.delta_quantile(base2, 0.95, min_count=20) is None
+        for _ in range(5):
+            h.record(0.01)
+        assert h.delta_quantile(base2, 0.95, min_count=20) is None
+        # one log bucket is ~7.5% wide and the windowed path has no
+        # observed-min/max clamp to tighten it
+        assert h.delta_quantile(base2, 0.95, min_count=5) \
+            == pytest.approx(0.01, rel=0.1)
+
 
 # -- spans -------------------------------------------------------------
 

@@ -111,6 +111,9 @@ EV_FLEET_REPLICA_DIED = _ev("fleet.replica_died")
 EV_FLEET_REPLICA_RESPAWNED = _ev("fleet.replica_respawned")
 EV_FLEET_DRAIN = _ev("fleet.drain")
 EV_FLEET_SHUTDOWN = _ev("fleet.shutdown")
+EV_FLEET_REPLICA_EJECTED = _ev("fleet.eject.replica")
+EV_FLEET_REPLICA_REINSTATED = _ev("fleet.eject.reinstated")
+EV_FLEET_PROBE_RESULT = _ev("fleet.probe.result")
 
 EV_SUPERVISOR_RESTART = _ev("supervisor.restart")
 EV_SUPERVISOR_RESUMED = _ev("supervisor.resumed")
@@ -150,6 +153,7 @@ CTR_SERVE_BATCHES = _ctr("serve.batches")
 CTR_SERVE_BATCH_SLOTS = _ctr("serve.batch_slots")
 CTR_SERVE_COMPILES = _ctr("serve.compiles")
 CTR_SERVE_SPILLS = _ctr("serve.spills")
+CTR_SERVE_DEADLINE_DROPPED = _ctr("serve.deadline_dropped")
 
 CTR_FLEET_REQUESTS = _ctr("fleet.requests")
 CTR_FLEET_REQUEST_ERRORS = _ctr("fleet.request_errors")
@@ -158,6 +162,17 @@ CTR_FLEET_RETRIES = _ctr("fleet.retries")
 CTR_FLEET_MIRRORED = _ctr("fleet.mirrored")
 CTR_FLEET_REPLICA_DEATHS = _ctr("fleet.replica_deaths")
 CTR_FLEET_REPLICA_RESPAWNS = _ctr("fleet.replica_respawns")
+CTR_FLEET_HEDGES = _ctr("fleet.hedge.issued")
+CTR_FLEET_HEDGE_WINS = _ctr("fleet.hedge.wins")
+CTR_FLEET_HEDGE_DENIED = _ctr("fleet.hedge.denied")
+CTR_FLEET_STALE_RESPONSES = _ctr("fleet.stale_response")
+CTR_FLEET_DEADLINE_MISSES = _ctr("fleet.deadline_misses")
+CTR_FLEET_INTEGRITY_STRIKES = _ctr("fleet.integrity_strikes")
+CTR_FLEET_EJECTIONS = _ctr("fleet.eject.total")
+CTR_FLEET_REINSTATEMENTS = _ctr("fleet.eject.reinstated_total")
+CTR_FLEET_PROBES = _ctr("fleet.probe.sent")
+CTR_FLEET_PROBES_OK = _ctr("fleet.probe.ok")
+CTR_FLEET_PROBES_FAILED = _ctr("fleet.probe.fail")
 
 CTR_EVALUATOR_JOBS = _ctr("evaluator.jobs")
 CTR_EVALUATOR_JOB_ERRORS = _ctr("evaluator.job_errors")
@@ -192,6 +207,8 @@ GAUGE_FLEET_REPLICAS_HEALTHY = _gauge("fleet.replicas_healthy")
 GAUGE_FLEET_INFLIGHT = _gauge("fleet.inflight")
 GAUGE_FLEET_EST_WAIT_MS = _gauge("fleet.est_wait_ms")
 GAUGE_FLEET_DISPATCH_EMA_MS = _gauge("fleet.dispatch_ema_ms")
+GAUGE_FLEET_HEDGE_THRESHOLD_MS = _gauge("fleet.hedge.threshold_ms")
+GAUGE_FLEET_REPLICAS_EJECTED = _gauge("fleet.eject.current")
 
 GAUGE_GA_LAST_HANG_WAIT = _gauge("ga.last_hang_wait")
 GAUGE_PREEMPT_SNAPSHOT_SECONDS = _gauge("preempt.snapshot_seconds")
@@ -230,6 +247,10 @@ SPAN_EVALUATOR_JOB_SECONDS = _span("evaluator.job_seconds")
 #: read): ``fleet.model.<name>.requests`` / ``.errors`` / ``.shed`` /
 #: ``.mirrored`` counters and a ``fleet.model.<name>.request_seconds``
 #: histogram, where <name> is the served model's registered name
+#: ...plus the sentinel's per-replica health split (the fleet_rows
+#: health column): a ``fleet.replica.<i>.health_score`` gauge and a
+#: ``fleet.replica.<i>.hedge_wins`` counter, where <i> is the replica
+#: index
 DYNAMIC_FAMILIES = (
     "fused.<kind>_dispatch_seconds",
     "fused.first_<kind>_dispatch_seconds",
@@ -240,6 +261,8 @@ DYNAMIC_FAMILIES = (
     "fleet.model.<name>.shed",
     "fleet.model.<name>.mirrored",
     "fleet.model.<name>.request_seconds",
+    "fleet.replica.<i>.health_score",
+    "fleet.replica.<i>.hedge_wins",
 )
 
 

@@ -52,6 +52,19 @@ Registered points (the call sites document their context keys):
                             (``attempt``/``gen``/``site``) — rehearses
                             an unannounced crash the supervisor must
                             resume from the newest intact state
+``hive.slow_dispatch``      a serving micro-batch dispatch stalls
+                            (``label`` = model name; knob: ``seconds``
+                            per dispatch) — the gray-failure replica
+                            that drags fleet p99 without dying
+``hive.wedge``              a serving request is swallowed unanswered
+                            while heartbeats and stats keep flowing
+                            (``model``) — wedged batcher, healthy-
+                            looking process
+``hive.garbage_response``   a serving response's probability payload
+                            is replaced with deterministic garbage
+                            AFTER the integrity checksum was computed
+                            from the clean payload (``model``) — the
+                            router's crc echo must catch it
 ==========================  ==========================================
 
 Determinism: the registry carries no clock and no global RNG — an
@@ -83,6 +96,9 @@ POINTS = frozenset((
     "multihost.peer_exit",
     "preempt.sigterm",
     "supervisor.child_crash",
+    "hive.slow_dispatch",
+    "hive.wedge",
+    "hive.garbage_response",
 ))
 
 _log = logging.getLogger("veles_tpu.faults")

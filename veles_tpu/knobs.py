@@ -185,6 +185,41 @@ FLEET_RESPAWN_BACKOFF = _knob(
     "Initial seconds the fleet monitor backs off before respawning a "
     "dead replica (doubles per consecutive death, capped at 30s).")
 
+# -- gray-failure defense (Sentinel) -----------------------------------
+
+FLEET_DEADLINE_MS = _knob(
+    "VELES_FLEET_DEADLINE_MS", 10000.0, float,
+    "Default per-request deadline the fleet router stamps onto every "
+    "request; it rides the JSONL protocol end-to-end so a hive "
+    "batcher drops already-expired rows before dispatch and a waiter "
+    "never burns more than this against a wedged replica.")
+FLEET_HEDGE_MIN_MS = _knob(
+    "VELES_FLEET_HEDGE_MIN_MS", 25.0, float,
+    "Floor of the adaptive hedge threshold: a request older than "
+    "max(this, the model's measured p95 latency) is hedged on a "
+    "second replica and the first answer wins.")
+FLEET_HEDGE_BUDGET = _knob(
+    "VELES_FLEET_HEDGE_BUDGET", 0.05, float,
+    "Cap on hedged requests as a fraction of admitted fleet traffic "
+    "(0 disables hedging) — hedges fight tail latency, the budget "
+    "keeps them from melting an already-overloaded fleet.")
+FLEET_EJECT_THRESHOLD = _knob(
+    "VELES_FLEET_EJECT_THRESHOLD", 3.0, float,
+    "Health-score level (decaying weighted strikes: deadline misses, "
+    "deaths, integrity failures, hedge losses, latency outliers) at "
+    "which the sentinel ejects a replica from routing; ejection is "
+    "capped at N-1 replicas so the fleet degrades, never "
+    "self-destructs.")
+FLEET_PROBE_OK = _knob(
+    "VELES_FLEET_PROBE_OK", 3, int,
+    "Consecutive clean synthetic probes an ejected replica must "
+    "answer before the sentinel reinstates it into routing.")
+FLEET_PROBE_INTERVAL = _knob(
+    "VELES_FLEET_PROBE_INTERVAL", 0.5, float,
+    "Initial seconds between synthetic canary probes of an ejected "
+    "replica (a failed probe doubles it, capped at 10s; a clean one "
+    "resets it).")
+
 # -- observability -----------------------------------------------------
 
 METRICS_DIR = _knob(

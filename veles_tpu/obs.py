@@ -97,12 +97,44 @@ def fleet_replica_dirs(metrics_dir: str):
     return sorted(out)
 
 
+def _sentinel_overlay(metrics_dir: str):
+    """{replica index: (state, health score, hedge wins)} from the
+    ROUTER process's own registry + journal: the sentinel's
+    eject/probe/reinstate events carry a ``state`` field, the
+    ``fleet.replica.<i>.*`` dynamic family carries score and hedge
+    wins — the operator's answer to WHY a replica is out of
+    rotation."""
+    reg, _snaps, _journals, events = load_dir(metrics_dir)
+    states = {}
+    for ev in events:
+        if ev.get("event") in ("fleet.eject.replica",
+                               "fleet.eject.reinstated",
+                               "fleet.probe.result") \
+                and ev.get("replica") is not None \
+                and ev.get("state"):
+            states[int(ev["replica"])] = ev["state"]
+    out = {}
+    for idx in set(states) | {
+            int(m.group(1)) for m in
+            (re.match(r"fleet\.replica\.(\d+)\.health_score$", n)
+             for n in reg.gauges) if m}:
+        g = reg.gauges.get(f"fleet.replica.{idx}.health_score")
+        c = reg.counters.get(f"fleet.replica.{idx}.hedge_wins")
+        out[idx] = (states.get(idx, "healthy"),
+                    g.value if g else None,
+                    int(c.value) if c else 0)
+    return out
+
+
 def fleet_rows(metrics_dir: str):
     """Per-replica fleet view rows from the merged child snapshots:
     pid (of the NEWEST snapshot — respawns leave older pids behind),
     resident models, live queue depth, lifetime qps (requests over
-    the ready->last-flush wall), and request p99."""
+    the ready->last-flush wall), request p99, and the sentinel health
+    overlay (state healthy/ejected/probing, health score, hedge
+    wins)."""
     rows = []
+    overlay = _sentinel_overlay(metrics_dir)
     for idx, path in fleet_replica_dirs(metrics_dir):
         reg, snaps, _journals, events = load_dir(path)
         pid = None
@@ -129,6 +161,8 @@ def fleet_rows(metrics_dir: str):
         g_res = reg.gauges.get("serve.models_resident")
         g_q = reg.gauges.get("serve.queue_depth")
         h = reg.histograms.get("serve.request_seconds")
+        state, score, hedge_wins = overlay.get(
+            idx, ("healthy", None, 0))
         rows.append({
             "replica": idx,
             "pid": pid,
@@ -139,6 +173,9 @@ def fleet_rows(metrics_dir: str):
             "qps": round(qps, 1) if qps is not None else None,
             "p99_ms": round(1000 * h.quantile(0.99), 3)
             if h and h.count else None,
+            "state": state,
+            "health_score": score,
+            "hedge_wins": hedge_wins,
         })
     return rows
 
@@ -192,14 +229,18 @@ def render_fleet(metrics_dir: str) -> str:
     out = ["-- fleet replicas --",
            f"  {'replica':>7} {'pid':>8} {'spawns':>6} "
            f"{'resident':>8} {'queue':>6} {'requests':>9} "
-           f"{'qps':>9} {'p99 ms':>9}"]
+           f"{'qps':>9} {'p99 ms':>9} {'state':>8} {'health':>7} "
+           f"{'hedge_w':>7}"]
     for r in rows:
         pid = "-" if r["pid"] is None else str(r["pid"])
         out.append(
             f"  {r['replica']:>7} {pid:>8} "
             f"{r['spawns']:>6} {_fmt(r['models_resident']):>8} "
             f"{_fmt(r['queue_depth']):>6} {_fmt(r['requests']):>9} "
-            f"{_fmt(r['qps']):>9} {_fmt(r['p99_ms']):>9}")
+            f"{_fmt(r['qps']):>9} {_fmt(r['p99_ms']):>9} "
+            f"{r.get('state', 'healthy'):>8} "
+            f"{_fmt(r.get('health_score')):>7} "
+            f"{_fmt(r.get('hedge_wins', 0)):>7}")
     mrows = fleet_model_rows(reg, events)
     if mrows:
         out.append("")

@@ -1080,16 +1080,18 @@ class EnsembleEvalEngine:
                                      sample_shape=sample_shape)
         return self._batcher
 
-    def submit(self, rows: np.ndarray):
+    def submit(self, rows: np.ndarray, deadline_ms=None):
         """Request-level inference: enqueue ``rows`` (one request of
         one or more samples) and return a ``concurrent.futures.Future``
         resolving to the mean member probabilities for exactly those
         rows.  The micro-batching loop coalesces concurrent requests —
-        this is the serving tier's whole-dataset-free entry point."""
+        this is the serving tier's whole-dataset-free entry point.
+        ``deadline_ms`` (absolute unix-epoch ms) lets the batcher drop
+        the request unanswered once nobody is waiting for it."""
         if self._batcher is None:
             raise RuntimeError("attach_batcher() first — submit() is "
                                "the micro-batched serving API")
-        return self._batcher.submit(rows)
+        return self._batcher.submit(rows, deadline_ms=deadline_ms)
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Block until every submitted request has resolved (the

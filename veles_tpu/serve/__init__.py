@@ -22,10 +22,15 @@ steady state runs with ZERO recompiles.
 - :mod:`veles_tpu.serve.router` — Swarm, the SLO-aware fleet router
   (``python -m veles_tpu --serve-fleet N NAME=PKG ...``): N hive
   replicas, placement-aware least-loaded routing, once-on-a-peer
-  failover, canary traffic mirroring, and admission-control shedding.
+  failover, canary traffic mirroring, and admission-control shedding;
+- :mod:`veles_tpu.serve.sentinel` — gray-failure defense: per-request
+  deadlines, budget-capped hedging, response-integrity verification,
+  and outlier ejection with probe-based reinstatement.
 """
 
-from veles_tpu.serve.batcher import MicroBatcher  # noqa: F401
+from veles_tpu.serve.batcher import (DeadlineExpired,  # noqa: F401
+                                     MicroBatcher)
 from veles_tpu.serve.client import ReplicaDied  # noqa: F401
 from veles_tpu.serve.fleet import PlacementPolicy  # noqa: F401
 from veles_tpu.serve.residency import ResidencyManager  # noqa: F401
+from veles_tpu.serve.sentinel import Sentinel  # noqa: F401

@@ -33,19 +33,33 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu import events, telemetry
+from veles_tpu import events, faults, telemetry
 from veles_tpu.ops import batching
+
+
+class DeadlineExpired(RuntimeError):
+    """A queued request's ``deadline_ms`` passed before its dispatch.
+
+    The batcher drops the request INSTEAD of computing an answer
+    nobody is waiting for — the router-side waiter already gave up (or
+    hedged onto a peer), so dispatching it would only steal the window
+    from requests that can still make their deadline."""
 
 
 class _Pending:
     """One submitted request: its rows, result slots, and Future."""
 
-    __slots__ = ("rows", "future", "t0", "results", "taken", "popped")
+    __slots__ = ("rows", "future", "t0", "results", "taken", "popped",
+                 "deadline_ms")
 
-    def __init__(self, rows: np.ndarray) -> None:
+    def __init__(self, rows: np.ndarray,
+                 deadline_ms: Optional[float] = None) -> None:
         self.rows = rows
         self.future: Future = Future()
         self.t0 = time.perf_counter()
+        #: absolute unix-epoch milliseconds (the wire clock shared
+        #: with the router); None = no deadline
+        self.deadline_ms = deadline_ms
         #: result slices in submission order (multi-dispatch requests)
         self.results: List[np.ndarray] = []
         #: rows already handed to a dispatch
@@ -90,14 +104,21 @@ class MicroBatcher:
 
     # -- producer side -------------------------------------------------
 
-    def submit(self, rows: Any) -> Future:
+    def submit(self, rows: Any,
+               deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one request of ``rows`` (one or more samples);
         returns a Future resolving to the per-row outputs in request
-        order.  Thread-safe; never blocks on the device."""
+        order.  Thread-safe; never blocks on the device.
+
+        ``deadline_ms`` (absolute unix-epoch milliseconds) marks when
+        the caller stops waiting: a request still fully queued past it
+        is dropped with :class:`DeadlineExpired` instead of
+        dispatched."""
         rows = np.asarray(rows, np.float32)
         if rows.ndim == 0 or len(rows) == 0:
             raise ValueError("a request needs at least one sample row")
-        p = _Pending(rows)
+        p = _Pending(rows, deadline_ms=float(deadline_ms)
+                     if deadline_ms is not None else None)
         with self._cond:
             if self._closed:
                 raise RuntimeError(f"batcher {self.label!r} is closed")
@@ -148,44 +169,74 @@ class MicroBatcher:
         """Wait for a flushable batch; returns [(request, start_row,
         n_rows)] covering up to ``max_batch`` rows, or None when closed
         and empty.  Flush condition: max_batch rows queued, or the
-        oldest request older than max_wait_s."""
-        with self._cond:
-            while True:
-                if self._queue:
-                    oldest = self._queue[0].t0
-                    if self._queued_rows >= self.max_batch:
+        oldest request older than max_wait_s.  Fully-queued requests
+        whose ``deadline_ms`` already passed are dropped with
+        :class:`DeadlineExpired` instead of dispatched (a request with
+        slices already in flight finishes — that compute is spent)."""
+        while True:
+            expired: List[_Pending] = []
+            with self._cond:
+                while True:
+                    now_ms = time.time() * 1000.0
+                    for p in list(self._queue):
+                        if p.taken == 0 and p.deadline_ms is not None \
+                                and now_ms > p.deadline_ms:
+                            self._queue.remove(p)
+                            self._queued_rows -= len(p.rows)
+                            expired.append(p)
+                    if expired:
+                        telemetry.gauge(
+                            events.GAUGE_SERVE_QUEUE_DEPTH).set(
+                            self._queued_rows)
                         break
-                    wait_left = self.max_wait_s - \
-                        (time.perf_counter() - oldest)
-                    if wait_left <= 0:
-                        break
-                    self._cond.wait(min(wait_left, 0.05))
-                elif self._closed:
-                    return None
-                else:
-                    self._cond.wait(0.05)
-            take: List[Tuple[_Pending, int, int]] = []
-            room = self.max_batch
-            while room > 0 and self._queue:
-                p = self._queue[0]
-                rem = len(p.rows) - p.taken
-                if rem > room and take:
-                    # whole requests coalesce; only a request that is
-                    # ALONE bigger than max_batch ever splits (its
-                    # slices lead consecutive dispatches)
-                    break
-                n = min(room, rem)
-                take.append((p, p.taken, n))
-                p.taken += n
-                room -= n
-                self._queued_rows -= n
-                if p.taken >= len(p.rows):
-                    self._queue.popleft()
-                    p.popped = True
-                    self._inflight += 1
-            telemetry.gauge(events.GAUGE_SERVE_QUEUE_DEPTH).set(
-                self._queued_rows)
-            return take
+                    if self._queue:
+                        oldest = self._queue[0].t0
+                        if self._queued_rows >= self.max_batch:
+                            break
+                        wait_left = self.max_wait_s - \
+                            (time.perf_counter() - oldest)
+                        if wait_left <= 0:
+                            break
+                        self._cond.wait(min(wait_left, 0.05))
+                    elif self._closed:
+                        return None
+                    else:
+                        self._cond.wait(0.05)
+                if not expired:
+                    take: List[Tuple[_Pending, int, int]] = []
+                    room = self.max_batch
+                    while room > 0 and self._queue:
+                        p = self._queue[0]
+                        rem = len(p.rows) - p.taken
+                        if rem > room and take:
+                            # whole requests coalesce; only a request
+                            # that is ALONE bigger than max_batch ever
+                            # splits (its slices lead consecutive
+                            # dispatches)
+                            break
+                        n = min(room, rem)
+                        take.append((p, p.taken, n))
+                        p.taken += n
+                        room -= n
+                        self._queued_rows -= n
+                        if p.taken >= len(p.rows):
+                            self._queue.popleft()
+                            p.popped = True
+                            self._inflight += 1
+                    telemetry.gauge(events.GAUGE_SERVE_QUEUE_DEPTH).set(
+                        self._queued_rows)
+                    return take
+            # outside the lock: the futures' done-callbacks (the
+            # hive's error emit) must not run under the batcher lock
+            telemetry.counter(events.CTR_SERVE_DEADLINE_DROPPED).inc(
+                len(expired))
+            now_ms = time.time() * 1000.0
+            for p in expired:
+                if not p.future.done():
+                    p.future.set_exception(DeadlineExpired(
+                        f"request expired "
+                        f"{now_ms - p.deadline_ms:.0f}ms past its "
+                        f"deadline before dispatch"))
 
     def _loop(self) -> None:
         while True:
@@ -202,6 +253,11 @@ class MicroBatcher:
                     telemetry.histogram(
                         events.HIST_SERVE_WAIT_SECONDS).record(
                         t_wait - p.t0)
+            f = faults.fire("hive.slow_dispatch", label=self.label)
+            if f:
+                # Faultline gray-failure rehearsal: the dispatch
+                # stalls but the process stays alive and heartbeating
+                time.sleep(float(f.get("seconds", 0.25)))
             try:
                 out = self.dispatch(xb)
             except BaseException as e:  # noqa: BLE001 — a failed

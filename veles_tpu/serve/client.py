@@ -28,6 +28,8 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from veles_tpu import events, telemetry
+
 
 class ReplicaDied(RuntimeError):
     """The serving subprocess died (EOF/exit) with requests pending.
@@ -90,6 +92,10 @@ class HiveClient:
         self._callbacks: Dict[int, Callable[[Optional[Dict[str, Any]],
                                              Optional[BaseException]],
                                             None]] = {}
+        #: wire ids whose waiter gave up (timeout cleanup / hedge
+        #: loser): the late response is dropped + counted
+        #: ``fleet.stale_response`` instead of leaking into _results
+        self._cancelled: set = set()
         self._next_id = 0
         self._eof = False
         self.exit_rc: Optional[int] = None
@@ -137,16 +143,31 @@ class HiveClient:
             except ValueError:
                 continue   # non-protocol noise is proof of life
             cb = None
+            stale = False
             with self._cond:
                 if msg.get("ready"):
                     self.hello = msg
                 elif "hb" in msg:
                     self.heartbeats += 1
                 elif msg.get("id") is not None:
-                    cb = self._callbacks.pop(msg["id"], None)
-                    if cb is None:
-                        self._results[msg["id"]] = msg
+                    mid = msg["id"]
+                    if mid in self._cancelled:
+                        # a hedge loser / timed-out waiter's late
+                        # answer: drop it — parking it in _results
+                        # would leak it into another waiter forever
+                        self._cancelled.discard(mid)
+                        stale = True
+                    elif not isinstance(mid, int) \
+                            or mid > self._next_id:
+                        stale = True   # an id this client never drew
+                    else:
+                        cb = self._callbacks.pop(mid, None)
+                        if cb is None:
+                            self._results[mid] = msg
                 self._cond.notify_all()
+            if stale:
+                telemetry.counter(
+                    events.CTR_FLEET_STALE_RESPONSES).inc()
             if cb is not None:
                 self._run_callback(cb, msg, None)
         # EOF: the replica is gone — fail EVERY pending waiter and
@@ -161,6 +182,7 @@ class HiveClient:
             self.exit_rc = rc
             callbacks = list(self._callbacks.values())
             self._callbacks.clear()
+            self._cancelled.clear()   # nothing late can arrive now
             self._cond.notify_all()
         for cb in callbacks:
             self._run_callback(cb, None, err)
@@ -204,13 +226,36 @@ class HiveClient:
 
     # -- API -----------------------------------------------------------
 
-    def submit(self, model: str, rows: Any) -> int:
+    def submit(self, model: str, rows: Any,
+               deadline_ms: Optional[float] = None) -> int:
         """Fire one request without waiting; returns its wire id
-        (collect with :meth:`wait_for` or :meth:`collect_async`)."""
+        (collect with :meth:`wait_for` or :meth:`collect_async`).
+        ``deadline_ms`` (absolute unix-epoch milliseconds) rides the
+        wire: the hive batcher drops the request unanswered once it
+        expires instead of computing for an absent waiter."""
         jid = self._draw_id()
-        self._send({"id": jid, "model": model,
-                    "rows": np.asarray(rows, np.float32).tolist()})
+        msg = {"id": jid, "model": model,
+               "rows": np.asarray(rows, np.float32).tolist()}
+        if deadline_ms is not None:
+            msg["deadline_ms"] = float(deadline_ms)
+        self._send(msg)
         return jid
+
+    def cancel(self, jid: int) -> bool:
+        """Abandon interest in request ``jid`` — the timeout-cleanup /
+        hedge-loser path.  Returns True when the response had already
+        arrived (it is dropped now); False when it is still pending,
+        in which case its eventual arrival is dropped and counted
+        ``fleet.stale_response`` instead of leaking into another
+        waiter."""
+        with self._cond:
+            if jid in self._results:
+                self._results.pop(jid)
+                return True
+            self._callbacks.pop(jid, None)
+            if not self._eof:
+                self._cancelled.add(jid)
+            return False
 
     def collect_async(self, jid: int,
                       callback: Callable[[Optional[Dict[str, Any]],

@@ -30,12 +30,20 @@ Protocol lines (stdout; all writes serialized under one lock):
     {"ready": true, "pid", "platform", "backend", "models": {...},
      "max_batch", "max_wait_ms"}                      -- hello
     {"hb": n, "pid"}                                  -- heartbeat
-    {"id", "model", "pred": [...], "probs": [[...]]}  -- response
+    {"id", "model", "pred": [...], "probs": [[...]],
+     "rows_n": n, "crc": c}                           -- response
     {"id", "error": "..."}                            -- failed request
+    {"id", "error": "...", "expired": true}           -- past deadline
     {"id", "stats": <telemetry snapshot>}             -- op=stats
 
-Requests (stdin): ``{"id", "model", "rows": [[...], ...]}``,
-``{"op": "stats", "id"}``, ``{"op": "shutdown"}``.
+Requests (stdin): ``{"id", "model", "rows": [[...], ...]}`` with an
+optional ``"deadline_ms"`` (absolute unix-epoch milliseconds — the
+batcher drops a request still queued past it instead of computing an
+answer nobody is waiting for), ``{"op": "stats", "id"}``,
+``{"op": "shutdown"}``.  ``rows_n``/``crc`` are the response-integrity
+echo: the row count and the crc32 of the float32 probability payload,
+recomputed by the fleet router — a mismatch is an integrity strike
+against this replica (Sentinel, veles_tpu/serve/sentinel.py).
 """
 
 from __future__ import annotations
@@ -48,11 +56,13 @@ import queue
 import signal
 import sys
 import threading
+import zlib
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from veles_tpu import events, knobs, telemetry
+from veles_tpu import events, faults, knobs, telemetry
+from veles_tpu.serve.batcher import DeadlineExpired
 from veles_tpu.supervisor import EXIT_PREEMPTED
 
 
@@ -298,11 +308,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             return True
         jid = job.get("id")
         telemetry.counter(events.CTR_SERVE_REQUESTS).inc()
+        if faults.fire("hive.wedge", model=job.get("model")):
+            # gray-failure rehearsal: the request vanishes into a
+            # wedged batcher while heartbeats/stats keep flowing — the
+            # caller's deadline (never this process) must catch it
+            return True
         try:
             model = job["model"]
             rows = np.asarray(job["rows"], np.float32)
             engine = residency.ensure(model)
-            fut = engine.submit(rows)
+            fut = engine.submit(rows, deadline_ms=job.get("deadline_ms"))
         except (KeyboardInterrupt, SystemExit):
             raise
         except BaseException as e:  # noqa: BLE001 — a bad request
@@ -314,12 +329,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         def _deliver(f, jid=jid, model=model) -> None:
             try:
                 probs = f.result()
+            except DeadlineExpired as e:
+                emit({"id": jid, "error": str(e), "expired": True})
+                return
             except BaseException as e:  # noqa: BLE001 — dispatch-side
                 emit({"id": jid, "error": f"{type(e).__name__}: {e}"})
                 return
+            probs32 = np.asarray(probs, np.float32)
+            # integrity echo: crc over the CLEAN f32 payload (float32
+            # round-trips JSON exactly) — computed BEFORE any injected
+            # corruption so hive.garbage_response is detectable
+            crc = zlib.crc32(probs32.tobytes())
+            payload = probs32
+            if faults.fire("hive.garbage_response", model=model):
+                payload = faults.rng("hive.garbage_response") \
+                    .standard_normal(probs32.shape).astype(np.float32)
             emit({"id": jid, "model": model,
-                  "pred": np.argmax(probs, axis=-1).tolist(),
-                  "probs": probs.tolist()})
+                  "pred": np.argmax(probs32, axis=-1).tolist(),
+                  "probs": payload.tolist(),
+                  "rows_n": int(len(probs32)), "crc": int(crc)})
 
         fut.add_done_callback(_deliver)
         return True

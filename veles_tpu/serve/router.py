@@ -29,7 +29,17 @@ concurrent requests out across them:
   receives a sampled fraction of NAME's traffic as asynchronous
   mirrors; per-model QPS/latency/error telemetry is split
   (``fleet.model.<name>.*``) so the A/B reads directly from
-  ``obs_report --fleet``.
+  ``obs_report --fleet``;
+- **gray-failure defense** (:mod:`veles_tpu.serve.sentinel`): every
+  request carries an absolute ``deadline_ms`` end-to-end (the hive
+  batcher drops expired rows before dispatch), a request older than
+  the adaptive hedge threshold is reissued on a second replica under
+  the ``$VELES_FLEET_HEDGE_BUDGET`` cap (first answer wins, the loser
+  is cancelled by wire id), responses are integrity-verified against
+  their row-count/crc echo, and a replica accumulating strikes —
+  deadline misses, deaths, integrity failures, hedge losses, latency
+  outliers — is EJECTED from routing, probed with synthetic canaries,
+  and reinstated after ``$VELES_FLEET_PROBE_OK`` clean probes.
 
 The CLI front end speaks the same JSONL protocol as a single hive
 (hello line, heartbeats, ``{"id", "model", "rows"}`` in /
@@ -48,6 +58,7 @@ import signal
 import sys
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -56,6 +67,7 @@ from veles_tpu import events, knobs, telemetry
 from veles_tpu.logger import Logger
 from veles_tpu.serve.client import ReplicaDied
 from veles_tpu.serve.fleet import PlacementPolicy, Replica, ReplicaSet
+from veles_tpu.serve.sentinel import Sentinel
 from veles_tpu.supervisor import EXIT_PREEMPTED
 
 
@@ -79,7 +91,16 @@ class FleetRouter(Logger):
                  max_inflight: Optional[int] = None,
                  heartbeat_deadline: Optional[float] = None,
                  respawn_backoff: Optional[float] = None,
-                 start_timeout: float = 300.0) -> None:
+                 start_timeout: float = 300.0,
+                 deadline_ms: Optional[float] = None,
+                 hedge_min_ms: Optional[float] = None,
+                 hedge_budget: Optional[float] = None,
+                 eject_threshold: Optional[float] = None,
+                 probe_ok: Optional[int] = None,
+                 probe_interval: Optional[float] = None,
+                 probe_backoff_cap: Optional[float] = None,
+                 env_overrides: Optional[Dict[int, Dict[str, str]]]
+                 = None) -> None:
         if n_replicas < 1:
             raise ValueError(f"a fleet needs >= 1 replica, got "
                              f"{n_replicas}")
@@ -116,16 +137,32 @@ class FleetRouter(Logger):
         self.max_inflight = int(max_inflight) \
             if max_inflight is not None \
             else int(knobs.get(knobs.FLEET_MAX_INFLIGHT))
+        #: default per-request deadline budget (ms); a request's
+        #: absolute deadline_ms = now + min(this, caller timeout)
+        self.deadline_ms = float(deadline_ms) \
+            if deadline_ms is not None \
+            else float(knobs.get(knobs.FLEET_DEADLINE_MS))
         if metrics_dir:
             telemetry.configure(metrics_dir)
         self.metrics_dir = metrics_dir
+
+        def _replica_env(i: int) -> Optional[Dict[str, str]]:
+            # per-replica overrides (gray-failure drills arm ONE
+            # replica's VELES_FAULTS without touching its peers)
+            over = (env_overrides or {}).get(i)
+            if not over:
+                return env
+            merged = dict(env or {})
+            merged.update(over)
+            return merged
 
         self.replicas = [
             Replica(i, self.models, backend=backend,
                     max_batch=max_batch, max_wait_ms=max_wait_ms,
                     hbm_budget=hbm_budget,
                     heartbeat_every=heartbeat_every,
-                    metrics_dir=metrics_dir, cwd=cwd, env=env,
+                    metrics_dir=metrics_dir, cwd=cwd,
+                    env=_replica_env(i),
                     start_timeout=start_timeout)
             for i in range(self.n_replicas)]
         self.fleet = ReplicaSet(
@@ -145,6 +182,16 @@ class FleetRouter(Logger):
         self._routed = [0] * self.n_replicas
         self._mirror_acc: Dict[str, float] = {}
         self._closed = False
+        #: gray-failure defense: health scoring, hedging governor,
+        #: ejection + probe/reinstate lifecycle
+        sentinel_kw = {}
+        if probe_backoff_cap is not None:
+            sentinel_kw["probe_backoff_cap"] = probe_backoff_cap
+        self.sentinel = Sentinel(
+            self.replicas, probe_fn=self._probe_replica,
+            hedge_min_ms=hedge_min_ms, hedge_budget=hedge_budget,
+            eject_threshold=eject_threshold, probe_ok=probe_ok,
+            probe_interval=probe_interval, **sentinel_kw)
         telemetry.event(events.EV_FLEET_PLACEMENT,
                         placement=self.placement)
         telemetry.event(
@@ -164,12 +211,14 @@ class FleetRouter(Logger):
 
     def _pick(self, model: str,
               exclude: Tuple[Replica, ...] = ()) -> Optional[Replica]:
-        """The least-loaded healthy replica holding ``model``; any
-        healthy replica when none of the placed set is (the fallback
-        LRU-loads the model on arrival)."""
+        """The least-loaded healthy, non-ejected replica holding
+        ``model``; any eligible replica when none of the placed set is
+        (the fallback LRU-loads the model on arrival).  A
+        sentinel-ejected replica sheds route around it — only probes
+        reach it until it is reinstated."""
         placed = set(self.placement.get(model, ()))
         healthy = [r for r in self.fleet.healthy()
-                   if r not in exclude]
+                   if r not in exclude and self.sentinel.eligible(r)]
         candidates = [r for r in healthy if r.idx in placed] \
             or healthy
         if not candidates:
@@ -193,16 +242,29 @@ class FleetRouter(Logger):
         return None
 
     def request(self, model: str, rows: Any,
-                timeout: float = 60.0) -> Dict[str, Any]:
+                timeout: float = 60.0,
+                deadline_ms: Optional[float] = None) -> Dict[str, Any]:
         """One routed round trip; returns the replica's response dict
         ({"pred", "probs"}), an {"error": ...} dict, or the shed
         response {"error": "overloaded", "overloaded": True}.  Never
-        raises for replica death or overload — the protocol carries
-        both."""
+        raises for replica death, overload, or a blown deadline — the
+        protocol carries all three.
+
+        ``deadline_ms`` is the request's ABSOLUTE unix-epoch deadline;
+        when None it is stamped as now + min($VELES_FLEET_DEADLINE_MS,
+        ``timeout``*1000) and rides the wire end-to-end."""
         telemetry.counter(events.CTR_FLEET_REQUESTS).inc()
         telemetry.counter(f"fleet.model.{model}.requests").inc()
+        rows = np.asarray(rows, np.float32)
+        self.sentinel.note_request(model, rows)
+        if deadline_ms is None:
+            budget = self.deadline_ms if self.deadline_ms > 0 \
+                else 1000.0 * timeout
+            if timeout:
+                budget = min(budget, 1000.0 * timeout)
+            deadline_ms = time.time() * 1000.0 + budget
         t0 = time.perf_counter()
-        resp = self._dispatch(model, rows, timeout)
+        resp = self._dispatch(model, rows, float(deadline_ms))
         if resp.get("overloaded"):
             telemetry.counter(events.CTR_FLEET_SHED).inc()
             telemetry.counter(f"fleet.model.{model}.shed").inc()
@@ -219,7 +281,7 @@ class FleetRouter(Logger):
         return resp
 
     def _dispatch(self, model: str, rows: Any,
-                  timeout: float) -> Dict[str, Any]:
+                  deadline_ms: float) -> Dict[str, Any]:
         r = self._pick(model)
         if r is None:
             return {"error": "no healthy replica", "model": model}
@@ -228,37 +290,260 @@ class FleetRouter(Logger):
             return {"error": "overloaded", "overloaded": True,
                     "model": model, "est_ms": round(est, 2)}
         tried: Tuple[Replica, ...] = ()
+        cur = r
+        resp: Dict[str, Any] = {"error": "unroutable", "model": model}
         for attempt in (0, 1):
-            cur = r
-            with self._lock:
-                self._routed[cur.idx] += 1
-            cur.acquire()
-            telemetry.gauge(events.GAUGE_FLEET_INFLIGHT).set(
-                self.inflight_total())
+            resp, verdict = self._routed_round(model, rows, cur,
+                                               deadline_ms, tried)
+            if verdict in ("ok", "timeout"):
+                # a blown deadline is FINAL: the budget is spent, a
+                # retry would only answer after nobody is waiting
+                return resp
+            # verdict died/integrity: retry ONCE on a healthy peer
+            # (idempotent inference) — the admission gate is not
+            # re-run, the request was already accepted
+            tried = tried + (cur,)
+            if attempt == 0:
+                telemetry.counter(events.CTR_FLEET_RETRIES).inc()
+                peer = self._pick(model, exclude=tried)
+                if peer is None:
+                    return {"error": f"replica failed ({verdict}) "
+                                     f"and no healthy peer",
+                            "model": model}
+                cur = peer
+        return resp
+
+    def _routed_round(self, model: str, rows: np.ndarray,
+                      primary: Replica, deadline_ms: float,
+                      tried: Tuple[Replica, ...]
+                      ) -> Tuple[Dict[str, Any], str]:
+        """One routed attempt with hedging: submit to ``primary``;
+        once the request's age crosses the adaptive hedge threshold
+        (and the hedge budget allows), issue a second copy on a
+        different replica, take the FIRST clean answer, and cancel the
+        loser by wire id.  Returns (response, verdict) with verdict
+        one of ``ok`` (also replica-side request errors — they are
+        deterministic, not gray), ``timeout`` (deadline blown),
+        ``died``, ``integrity``.
+
+        Structured in two phases so the HOT path (the answer beats the
+        hedge threshold, i.e. almost always) costs exactly what the
+        pre-sentinel router did — one submit + one blocking wait; the
+        per-request fan-in queue exists only for the rare request that
+        actually hedges."""
+        n_rows = int(len(rows))
+        t_start = time.perf_counter()
+
+        def timeout_resp() -> Tuple[Dict[str, Any], str]:
+            return ({"error": "deadline exceeded", "model": model,
+                     "timeout": True,
+                     "deadline_ms": round(deadline_ms, 1)}, "timeout")
+
+        def evaluate(rep: Replica, msg: Dict[str, Any]) \
+                -> Tuple[Dict[str, Any], str]:
+            """Judge one ANSWERED leg (the caller already released)."""
+            if "error" in msg:
+                if msg.get("expired"):
+                    # the hive's own batcher dropped it past deadline:
+                    # that replica's queue blew the budget
+                    self.sentinel.record_timeout(rep)
+                    return timeout_resp()
+                # a deterministic request error (bad shape, unknown
+                # model): return it as-is — no strike, no retry
+                return msg, "ok"
+            if not self._verify_integrity(msg, n_rows):
+                self.sentinel.record_integrity(rep)
+                return ({"error": "response failed integrity check",
+                         "model": model}, "integrity")
+            self.sentinel.record_ok(
+                rep, model, time.perf_counter() - t_start)
+            return msg, "ok"
+
+        remain_s = (deadline_ms - time.time() * 1000.0) / 1000.0
+        if remain_s <= 0:
+            return timeout_resp()
+        with self._lock:
+            self._routed[primary.idx] += 1
+        primary.acquire()
+        telemetry.gauge(events.GAUGE_FLEET_INFLIGHT).set(
+            self.inflight_total())
+        try:
+            jid = primary.client.submit(model, rows,
+                                        deadline_ms=deadline_ms)
+        except ReplicaDied:
+            primary.release()
+            primary.mark_dead()
+            self.sentinel.record_died(primary)
+            return {"error": "replica died", "model": model}, "died"
+        # -- phase 1: plain wait until the hedge threshold ------------
+        hedge_thr_s = self.sentinel.hedge_threshold_ms(model) / 1000.0
+        try:
+            msg = primary.client.wait_for(
+                jid, timeout=max(0.001, min(hedge_thr_s, remain_s)))
+        except TimeoutError:
+            msg = None
+        except ReplicaDied:
+            primary.release()
+            primary.mark_dead()
+            self.sentinel.record_died(primary)
+            return {"error": "replica died", "model": model}, "died"
+        if msg is not None:
+            primary.release()
+            return evaluate(primary, msg)
+        # -- the request outlived the hedge threshold -----------------
+        remain_s = (deadline_ms - time.time() * 1000.0) / 1000.0
+        if remain_s <= 0:
+            primary.client.cancel(jid)
+            primary.release()
+            self.sentinel.record_timeout(primary)
+            return timeout_resp()
+        peer: Optional[Replica] = None
+        if self.sentinel.hedge_budget > 0:
+            cand = self._pick(model, exclude=tried + (primary,))
+            # a hedge duplicates load: it must pass the SAME admission
+            # gate a fresh request would — hedging fights tail
+            # latency, never overload (an overloaded peer would only
+            # queue the copy)
+            if cand is not None and self._shed(cand) is None \
+                    and self.sentinel.allow_hedge():
+                peer = cand
+            elif cand is not None:
+                telemetry.counter(events.CTR_FLEET_HEDGE_DENIED).inc()
+        if peer is None:
+            # no hedge possible: wait the primary out to the deadline
             try:
-                return cur.client.wait_for(
-                    cur.client.submit(model, rows), timeout)
-            except ReplicaDied:
-                # the monitor will respawn it; this request retries
-                # ONCE on a healthy peer (idempotent inference) — the
-                # admission gate is not re-run, the request was
-                # already accepted
-                cur.mark_dead()
-                tried = tried + (cur,)
-                if attempt == 0:
-                    telemetry.counter(events.CTR_FLEET_RETRIES).inc()
-                    peer = self._pick(model, exclude=tried)
-                    if peer is None:
-                        return {"error": "replica died and no "
-                                         "healthy peer",
-                                "model": model}
-                    r = peer
+                msg = primary.client.wait_for(
+                    jid, timeout=max(0.001, remain_s))
             except TimeoutError:
-                return {"error": f"timeout after {timeout}s",
-                        "model": model}
-            finally:
-                cur.release()
-        return {"error": "replica died twice", "model": model}
+                primary.client.cancel(jid)
+                primary.release()
+                self.sentinel.record_timeout(primary)
+                return timeout_resp()
+            except ReplicaDied:
+                primary.release()
+                primary.mark_dead()
+                self.sentinel.record_died(primary)
+                return ({"error": "replica died", "model": model},
+                        "died")
+            primary.release()
+            return evaluate(primary, msg)
+        # -- phase 2: the hedged fan-in (the rare, already-slow case) -
+        telemetry.counter(events.CTR_FLEET_HEDGES).inc()
+        results: "queue.SimpleQueue[Tuple[Replica, int, Any, Any]]" \
+            = queue.SimpleQueue()
+        outstanding: Dict[Tuple[int, int], Replica] = {}
+        outstanding[(primary.idx, jid)] = primary
+        primary.client.collect_async(
+            jid, lambda m, e, rep=primary, j=jid:
+            results.put((rep, j, m, e)))
+        with self._lock:
+            self._routed[peer.idx] += 1
+        peer.acquire()
+        try:
+            hjid = peer.client.submit(model, rows,
+                                      deadline_ms=deadline_ms)
+            outstanding[(peer.idx, hjid)] = peer
+            peer.client.collect_async(
+                hjid, lambda m, e, rep=peer, j=hjid:
+                results.put((rep, j, m, e)))
+        except ReplicaDied:
+            peer.release()
+            peer.mark_dead()
+            self.sentinel.record_died(peer)
+
+        def drop_outstanding(score_timeout: bool) -> None:
+            for (idx, ojid), rep in list(outstanding.items()):
+                rep.client.cancel(ojid)
+                rep.release()
+                if score_timeout:
+                    self.sentinel.record_timeout(rep)
+            outstanding.clear()
+
+        fail: Optional[Tuple[Dict[str, Any], str]] = None
+        while outstanding:
+            remain_s = (deadline_ms - time.time() * 1000.0) / 1000.0
+            if remain_s <= 0:
+                drop_outstanding(score_timeout=True)
+                return timeout_resp()
+            try:
+                rep, rjid, msg, err = results.get(
+                    timeout=max(0.001, remain_s))
+            except queue.Empty:
+                continue
+            if (rep.idx, rjid) not in outstanding:
+                continue   # already cancelled
+            outstanding.pop((rep.idx, rjid))
+            rep.release()
+            if err is not None:
+                rep.mark_dead()
+                self.sentinel.record_died(rep)
+                fail = ({"error": "replica died", "model": model},
+                        "died")
+                continue   # the other leg may still answer
+            out = evaluate(rep, msg)
+            if out[1] == "ok":
+                if rep is peer and "probs" in out[0]:
+                    self.sentinel.record_hedge_win(rep, primary)
+                drop_outstanding(score_timeout=False)
+                return out
+            fail = out   # expired / integrity: other leg may save it
+        return fail if fail is not None \
+            else ({"error": "replica died", "model": model}, "died")
+
+    @staticmethod
+    def _verify_integrity(msg: Dict[str, Any], n_rows: int) -> bool:
+        """The response-integrity echo: the probability payload must
+        carry exactly the requested row count and match the crc32 the
+        hive computed over its clean float32 payload (float32
+        round-trips JSON exactly, so any wire/compute corruption
+        breaks the checksum).  Responses from pre-echo hives (no crc
+        field) pass — the row-count check still applies."""
+        if "probs" not in msg:
+            return True
+        try:
+            probs = np.asarray(msg["probs"], np.float32)
+        except (TypeError, ValueError):
+            return False
+        if probs.ndim < 1 or len(probs) != n_rows:
+            return False
+        rows_n = msg.get("rows_n")
+        if rows_n is not None and int(rows_n) != len(probs):
+            return False
+        crc = msg.get("crc")
+        if crc is not None \
+                and zlib.crc32(probs.tobytes()) != int(crc):
+            return False
+        return True
+
+    def _probe_replica(self, r: Replica, model: str,
+                       rows: np.ndarray) -> Tuple[bool, str]:
+        """One synthetic canary request aimed STRAIGHT at replica
+        ``r`` (bypassing routing — it is ejected) — the sentinel's
+        reinstatement evidence.  Clean = answered inside the probe
+        deadline AND integrity-verified."""
+        timeout_s = max(1.0,
+                        4.0 * self.sentinel.hedge_threshold_ms(model)
+                        / 1000.0)
+        if not r.healthy or r.client is None:
+            return False, "replica process down"
+        deadline_ms = time.time() * 1000.0 + 1000.0 * timeout_s
+        try:
+            jid = r.client.submit(model, rows,
+                                  deadline_ms=deadline_ms)
+        except ReplicaDied as e:
+            return False, f"died at submit: {e}"
+        try:
+            msg = r.client.wait_for(jid, timeout=timeout_s)
+        except TimeoutError:
+            r.client.cancel(jid)
+            return False, f"no answer in {timeout_s:.1f}s"
+        except ReplicaDied as e:
+            return False, f"died: {e}"
+        if "error" in msg:
+            return False, f"error: {msg['error']}"
+        if not self._verify_integrity(msg, int(len(rows))):
+            return False, "integrity mismatch"
+        return True, "clean"
 
     def _maybe_mirror(self, primary: str, rows: Any,
                       timeout: float) -> None:
@@ -285,7 +570,11 @@ class FleetRouter(Logger):
             t0 = time.perf_counter()
             r.acquire()
             try:
-                jid = r.client.submit(cname, rows)
+                jid = r.client.submit(
+                    cname, rows,
+                    deadline_ms=time.time() * 1000.0
+                    + self.deadline_ms if self.deadline_ms > 0
+                    else None)
             except ReplicaDied:
                 r.release()
                 r.mark_dead()
@@ -331,7 +620,9 @@ class FleetRouter(Logger):
         return out
 
     def fleet_status(self) -> Dict[str, Any]:
-        """One JSON-ready view of the fleet (the CLI's op=fleet)."""
+        """One JSON-ready view of the fleet (the CLI's op=fleet),
+        including each replica's sentinel health row — the operator's
+        answer to "why is replica i out of rotation"."""
         return {
             "replicas": [
                 {"replica": r.idx, "pid": r.pid,
@@ -340,13 +631,16 @@ class FleetRouter(Logger):
                  "deaths": r.deaths,
                  "ema_dispatch_ms": round(
                      1000 * r.ema_dispatch_s, 3)
-                 if r.ema_dispatch_s else None}
+                 if r.ema_dispatch_s else None,
+                 "sentinel": self.sentinel.status(r)}
                 for r in self.replicas],
             "placement": self.placement,
             "canaries": {c: {"of": p, "fraction": f}
                          for c, (p, f) in self.canaries.items()},
             "slo_p99_ms": self.slo_p99_ms,
             "max_inflight": self.max_inflight,
+            "deadline_ms": self.deadline_ms,
+            "hedge_rate": round(self.sentinel.hedge_rate(), 4),
         }
 
     # -- teardown ------------------------------------------------------
@@ -365,6 +659,7 @@ class FleetRouter(Logger):
         if self._closed:
             return
         self._closed = True
+        self.sentinel.close()
         self.fleet.close(kill=kill)
         telemetry.event(events.EV_FLEET_SHUTDOWN,
                         routed=self.routed_counts(), reason=reason,
@@ -431,6 +726,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=int(knobs.get(knobs.FLEET_MAX_INFLIGHT)),
                    help="per-replica in-flight bound "
                         "($VELES_FLEET_MAX_INFLIGHT)")
+    p.add_argument("--deadline-ms", type=float,
+                   default=float(knobs.get(knobs.FLEET_DEADLINE_MS)),
+                   help="default per-request deadline budget "
+                        "($VELES_FLEET_DEADLINE_MS); a request's "
+                        "own deadline_ms field overrides it")
     p.add_argument("--heartbeat-every", type=float,
                    default=float(knobs.get(knobs.HEARTBEAT_EVERY)))
     p.add_argument("--metrics-dir", default=None,
@@ -479,7 +779,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 budget_bytes=args.hbm_budget or None,
                 hot=set(args.hot) if args.hot else None),
             slo_p99_ms=args.slo_p99_ms,
-            max_inflight=args.max_inflight)
+            max_inflight=args.max_inflight,
+            deadline_ms=args.deadline_ms)
     except (ValueError, RuntimeError) as e:
         print(f"--serve-fleet: {e}", file=sys.stderr)
         return 2
@@ -573,8 +874,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             emit({"id": jid, "error": f"{type(e).__name__}: {e}"})
             return True
 
-        def _route(jid=jid, model=model, rows=rows) -> None:
-            resp = router.request(model, rows)
+        def _route(jid=jid, model=model, rows=rows,
+                   dl=job.get("deadline_ms")) -> None:
+            # a client-supplied deadline_ms rides through unchanged —
+            # the fleet front end is deadline-transparent
+            resp = router.request(model, rows, deadline_ms=dl)
             resp = dict(resp)
             resp["id"] = jid
             emit(resp)

@@ -205,6 +205,44 @@ class Histogram:
             cum += c
         return self.max
 
+    def snapshot_buckets(self) -> Any:
+        """An opaque (bucket counts, count) base for
+        :meth:`delta_quantile` — take one per window edge."""
+        with self._lock:
+            return list(self.buckets), self.count
+
+    def delta_quantile(self, base: Any, q: float,
+                       min_count: int = 1) -> Optional[float]:
+        """The q-quantile of ONLY the samples recorded since ``base``
+        (a :meth:`snapshot_buckets` result) — the windowed read the
+        fleet sentinel's adaptive hedge threshold uses: a cumulative
+        quantile lags the live distribution badly when load shifts,
+        so hedging against it fires on far more than the intended
+        tail.  None when fewer than ``min_count`` samples landed in
+        the window."""
+        base_buckets, base_count = base
+        with self._lock:
+            delta = [b - p for b, p in zip(self.buckets,
+                                           base_buckets)]
+            n = self.count - base_count
+        if n < max(1, min_count):
+            return None
+        target = max(1, math.ceil(q * n))
+        cum = 0
+        for i, c in enumerate(delta):
+            if c <= 0:
+                continue
+            if cum + c >= target:
+                if i == 0:
+                    return 10.0 ** LOG_LO
+                if i == NBUCKETS + 1:
+                    return 10.0 ** LOG_HI
+                e0 = 10.0 ** (LOG_LO + (i - 1) / PER_DECADE)
+                frac = (target - cum) / c
+                return e0 * (_STEP ** frac)
+            cum += c
+        return None
+
     def to_dict(self) -> Dict[str, Any]:
         with self._lock:
             d: Dict[str, Any] = {
