@@ -421,15 +421,28 @@ def lint_metric(phase):
     fault drill — zero new findings is an invariant with a measured
     trajectory, exactly like recovery and performance."""
     try:
-        from veles_tpu.analysis import repo_scan
+        from veles_tpu.analysis import repo_scan, repo_root
+        from veles_tpu.analysis import flow
         new, baseline = repo_scan()
         if new:
             for f in new[:20]:
                 print(f"veleslint: {f.format()}", file=sys.stderr)
+        by_rule = {}
+        for f in new:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        law = flow.load_lock_order(os.path.join(
+            repo_root(), "veles_tpu", "analysis",
+            "lock_order.json")) or {}
         phase(f"veleslint: {len(new)} new finding(s), "
-              f"{len(baseline)} baselined")
+              f"{len(baseline)} baselined; locking law "
+              f"{len(law.get('nodes', []))} locks / "
+              f"{len(law.get('edges', []))} edges")
         return {"lint_findings_new": len(new),
-                "lint_baseline_count": len(baseline)}
+                "lint_findings_new_by_rule": by_rule,
+                "lint_baseline_count": len(baseline),
+                "lock_order_nodes": len(law.get("nodes", [])),
+                "lock_order_edges": len(law.get("edges", []))
+                + len(law.get("manual_edges", []))}
     except Exception as e:  # noqa: BLE001 — enrichment only
         print(f"veleslint did not run: {e}", file=sys.stderr)
         return None
@@ -1808,7 +1821,10 @@ def main() -> None:
         "fault_drill_failures": None,
         "fault_drill_journal_verified": None,
         "lint_findings_new": None,
+        "lint_findings_new_by_rule": None,
         "lint_baseline_count": None,
+        "lock_order_nodes": None,
+        "lock_order_edges": None,
         "preempt_snapshot_sec": None,
         "resume_downtime_sec": None,
         "resume_trajectory_match": None,

@@ -768,6 +768,11 @@ def _fleet_pkg(d):
     return pkg, oracle
 
 
+#: metric dirs the fleet drills pointed replicas at — the lock
+#: witness pass unions their lockwitness-<pid>.json files at the end
+WITNESS_DIRS: list = []
+
+
 def _gray_fleet(fault, d, **kw):
     """A REAL 2-replica fleet with replica 0 armed via a per-replica
     VELES_FAULTS override (replica 1 explicitly disarmed)."""
@@ -779,6 +784,7 @@ def _gray_fleet(fault, d, **kw):
         env={"VELES_FAULTS": ""},
         env_overrides={0: {"VELES_FAULTS": fault}})
     defaults.update(kw)
+    WITNESS_DIRS.append(defaults["metrics_dir"])
     return FleetRouter({"m": pkg}, **defaults), oracle
 
 
@@ -953,15 +959,40 @@ def main(argv=None) -> int:
         telemetry.configure(tempfile.mkdtemp(prefix="chaos_metrics_"))
     log(f"journal/metrics dir: {telemetry.metrics_dir()}")
 
+    # Lockstep pass: the whole matrix runs under the lock-order
+    # witness — every child process inherits the arming, and at the
+    # end every runtime-observed acquisition edge must be declared in
+    # the static locking law (analysis/lock_order.json)
+    os.environ.setdefault("VELES_LOCK_WITNESS", "1")
+
     todo = [f for f in DRILLS
             if not args.only or args.only in f.__name__]
     results = [drill(f) for f in todo]
     ok = all(r["ok"] for r in results)
+
+    from veles_tpu.analysis import flow, witness
+    observed = set(witness.observed_edges())
+    for mdir in [telemetry.metrics_dir()] + WITNESS_DIRS:
+        if mdir and os.path.isdir(mdir):
+            observed |= set(witness.read_snapshots(mdir))
+    law = flow.load_lock_order(os.path.join(
+        REPO, "veles_tpu", "analysis", "lock_order.json"))
+    undeclared = sorted(observed - flow.declared_edges(law or {}))
+    witness_ok = law is not None and not undeclared
+    if undeclared:
+        log(f"LOCK WITNESS: undeclared runtime edges {undeclared} — "
+            f"the static locking law has a gap")
+    else:
+        log(f"lock witness: {len(observed)} observed edge(s), all "
+            f"declared in the locking law")
+    ok = ok and witness_ok
     record = {
         "fault_drill_ok": ok,
         "fault_drill_journal_verified": bool(results) and all(
             r.get("journal_event") or r.get("skipped")
             for r in results),
+        "lock_witness_ok": witness_ok,
+        "lock_witness_edges": len(observed),
         "results": results,
     }
     print(json.dumps(record), flush=True)

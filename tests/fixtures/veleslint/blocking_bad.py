@@ -1,0 +1,38 @@
+"""Seeded blocking-under-lock violations: sleeps, untimed queue
+ops, Future.result, and a transitive sleep through a helper — all
+while a lock is held."""
+import queue
+import subprocess
+import threading
+import time
+
+_q = queue.Queue()
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._proc = subprocess.Popen(["true"])
+
+    def sleeps_under_lock(self):
+        with self._lock:
+            time.sleep(1.0)            # finding: time.sleep
+
+    def untimed_queue_get(self):
+        with self._lock:
+            return _q.get()            # finding: Queue.get no timeout
+
+    def untimed_future(self, fut):
+        with self._lock:
+            return fut.result()        # finding: .result() no timeout
+
+    def waits_process(self):
+        with self._lock:
+            self._proc.wait()          # finding: Popen.wait no timeout
+
+    def indirect(self):
+        with self._lock:
+            self._helper()             # finding: sleeps via _helper
+
+    def _helper(self):
+        time.sleep(0.5)

@@ -1,0 +1,35 @@
+"""Clean twin: waits on the held condition (which releases it),
+bounded timeouts, and blocking work moved outside the critical
+section."""
+import queue
+import threading
+import time
+
+_q = queue.Queue()
+
+
+class Worker:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._lock = threading.Lock()
+
+    def cond_wait_is_fine(self):
+        with self._cond:
+            self._cond.wait(0.1)       # releases the held lock
+
+    def timeout_bounded(self):
+        with self._lock:
+            pass
+        return _q.get(timeout=1.0)     # outside the lock anyway
+
+    def future_with_timeout(self, fut):
+        with self._lock:
+            snapshot = 1
+        time.sleep(0.01)               # outside the lock
+        return fut.result(timeout=2.0), snapshot
+
+    def work_outside(self):
+        with self._lock:
+            payload = list(range(3))
+        time.sleep(0.01)
+        return payload

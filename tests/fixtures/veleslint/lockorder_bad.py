@@ -1,0 +1,25 @@
+"""Seeded lock-order cycle: two locks acquired in opposite orders on
+two paths — the classic AB/BA deadlock, one of them through a direct
+call."""
+import threading
+
+from veles_tpu.analysis import witness
+
+_alpha = witness.lock("fx.alpha")
+_beta = threading.Lock()
+
+
+def forward():
+    with _alpha:
+        with _beta:
+            return 1
+
+
+def _grab_alpha():
+    with _alpha:
+        return 2
+
+
+def backward():
+    with _beta:
+        return _grab_alpha()   # beta -> alpha: closes the cycle

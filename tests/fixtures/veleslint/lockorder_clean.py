@@ -1,0 +1,24 @@
+"""Clean twin: both paths acquire in the same global order — nested
+acquisition makes edges, but never a cycle."""
+import threading
+
+from veles_tpu.analysis import witness
+
+_alpha = witness.lock("fx.alpha")
+_beta = threading.Lock()
+
+
+def forward():
+    with _alpha:
+        with _beta:
+            return 1
+
+
+def also_forward():
+    with _alpha:
+        return _grab_beta()
+
+
+def _grab_beta():
+    with _beta:
+        return 2

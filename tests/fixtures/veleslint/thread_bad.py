@@ -1,0 +1,9 @@
+"""Seeded thread-lifecycle violation: a non-daemon thread in a
+module that never joins anything."""
+import threading
+
+
+def spawn(worker):
+    t = threading.Thread(target=worker, name="straggler")  # finding
+    t.start()
+    return t

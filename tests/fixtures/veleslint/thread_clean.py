@@ -1,0 +1,18 @@
+"""Clean twin: daemon threads, and a non-daemon thread the module
+provably joins on its shutdown path."""
+import threading
+
+
+def spawn_daemon(worker):
+    t = threading.Thread(target=worker, daemon=True,
+                         name="background")
+    t.start()
+    return t
+
+
+def spawn_and_join(worker):
+    ts = [threading.Thread(target=worker) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()

@@ -1,0 +1,29 @@
+"""Seeded waiter-discipline violations: the PR-12 timeout leak (an
+exception edge abandons the wire id), a normal-path abandon, and a
+dropped submit handle."""
+from concurrent.futures import Future
+
+
+class Router:
+    def timeout_leak(self, client, model, rows):
+        jid = client.submit(model, rows)       # finding: exc path
+        try:
+            return client.wait_for(jid, timeout=1.0)
+        except TimeoutError:
+            return None                        # jid never cancelled
+
+    def branch_leak(self, client, model, rows, fast):
+        jid = client.submit(model, rows)       # finding: normal path
+        if fast:
+            return client.wait_for(jid, timeout=1.0)
+        return None                            # jid abandoned
+
+    def dropped(self, pool, fn):
+        pool.submit(fn)                        # finding: dropped
+
+    def future_leak(self, ok):
+        fut = Future()                         # finding: normal path
+        if ok:
+            fut.set_result(1)
+            return fut
+        return None                            # fut abandoned

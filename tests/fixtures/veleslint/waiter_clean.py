@@ -1,0 +1,37 @@
+"""Clean twin: every waiter is resolved, cancelled, or handed off on
+every path — exception edges included."""
+from concurrent.futures import Future
+
+
+class Router:
+    def cancel_on_timeout(self, client, model, rows):
+        jid = client.submit(model, rows)
+        try:
+            return client.wait_for(jid, timeout=1.0)
+        except TimeoutError:
+            client.cancel(jid)
+            return None
+
+    def handoff_to_container(self, client, model, rows, pending):
+        jid = client.submit(model, rows)
+        pending[jid] = model                   # stored = handed off
+        return jid
+
+    def callback_resolves(self, pool, fn, done):
+        fut = pool.submit(fn)
+        fut.add_done_callback(done)
+
+    def closure_handoff(self, pool, fn):
+        fut = pool.submit(fn)
+
+        def reaper():
+            return fut.result(timeout=5.0)     # captured = handoff
+        return reaper
+
+    def always_resolves(self, ok):
+        fut = Future()
+        if ok:
+            fut.set_result(1)
+        else:
+            fut.cancel()
+        return fut

@@ -1,0 +1,15 @@
+"""Seeded wire-protocol violations: typo'd and ad-hoc keys in dicts
+flowing to the wire (emit arg, assigned-then-sent, returned
+response)."""
+import json
+
+
+def emit(obj):
+    print(json.dumps(obj))
+
+
+def answer(jid):
+    emit({"id": jid, "modle": "x"})            # finding: typo'd key
+    hello = {"ready": True, "bogus_field": 1}  # finding: ad-hoc key
+    emit(hello)
+    return {"error": "y", "why_not": 2}        # finding: ad-hoc key

@@ -1,0 +1,22 @@
+"""Clean twin: declared keys only, dynamic keys skipped, dicts
+handed to constructors are not wire payloads."""
+import json
+
+
+def emit(obj):
+    print(json.dumps(obj))
+
+
+class Meta:
+    def __init__(self, meta):
+        self.meta = meta
+
+
+def answer(jid, model, key):
+    emit({"id": jid, "model": model, "probs": [], "rows_n": 0,
+          "crc": 0})
+    resp = {"error": "overloaded", "overloaded": True}
+    emit(resp)
+    emit({key: 1})                       # dynamic key: skipped
+    Meta({"workflow": None, "package": "p"})   # ctor arg: not wire
+    return {"id": jid, "expired": True}

@@ -1,0 +1,368 @@
+"""Lockstep (ISSUE 13): the flow-aware concurrency rules catch their
+seeded fixtures and pass the clean twins, the checked-in locking law
+(analysis/lock_order.json) is cycle-free and drift-free, and the
+runtime witness — armed over a REAL serving subprocess plus in-process
+batcher/sentinel traffic — observes only edges the static law
+declares (at least 3 distinct ones, proving it actually recorded),
+while costing nothing when disabled (the factories return the bare
+threading primitives)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from veles_tpu.analysis import Config, repo_root, scan_source
+from veles_tpu.analysis import flow, witness
+from veles_tpu.analysis.engine import ModuleContext
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "veleslint")
+
+
+def fixture(name: str) -> str:
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+        return f.read()
+
+
+def project_for(sources, config=None):
+    """{path: source} -> (Project, config) for the flow analyses."""
+    config = config or Config()
+    ctxs = [ModuleContext(p, s, config) for p, s in sources.items()]
+    return flow.build_project(ctxs), config
+
+
+LAW_PATH = os.path.join(repo_root(), "veles_tpu", "analysis",
+                        "lock_order.json")
+
+
+# -- blocking-under-lock -----------------------------------------------
+
+class TestBlockingUnderLock:
+    PATH = "veles_tpu/serve/_fx_blocking.py"
+
+    def _scan(self, name):
+        project, _ = project_for({self.PATH: fixture(name)})
+        return flow.blocking_findings(project, [self.PATH])
+
+    def test_catches_seeded(self):
+        got = self._scan("blocking_bad.py")
+        whats = {f.detail.split(":", 1)[1].split(" (")[0]
+                 for f in got}
+        assert "time.sleep()" in whats
+        assert "Queue.get() with no timeout" in whats
+        assert ".result() with no timeout" in whats
+        assert "Popen.wait() with no timeout" in whats
+        # the transitive case: a helper that sleeps, called under
+        # the lock — flagged at the call site with the chain
+        indirect = [f for f in got
+                    if f.detail.startswith("Worker.indirect")]
+        assert indirect and "via" in indirect[0].detail, got
+
+    def test_clean(self):
+        assert self._scan("blocking_clean.py") == []
+
+
+# -- waiter-discipline -------------------------------------------------
+
+class TestWaiterDiscipline:
+    PATH = "veles_tpu/serve/_fx_waiter.py"
+
+    def _scan(self, name):
+        project, _ = project_for({self.PATH: fixture(name)})
+        return flow.waiter_findings(project, [self.PATH])
+
+    def test_catches_seeded(self):
+        got = self._scan("waiter_bad.py")
+        by_fn = {f.detail.split(":", 1)[0].split(".")[-1]
+                 for f in got}
+        assert by_fn == {"timeout_leak", "branch_leak", "dropped",
+                         "future_leak"}, got
+        # the PR-12 class specifically: the exception edge
+        tl = [f for f in got if "timeout_leak" in f.detail]
+        assert "exception path" in tl[0].message
+
+    def test_clean(self):
+        assert self._scan("waiter_clean.py") == []
+
+
+# -- lock-order --------------------------------------------------------
+
+class TestLockOrderGraph:
+    PATH = "veles_tpu/serve/_fx_lockorder.py"
+
+    def _graph(self, name):
+        project, _ = project_for({self.PATH: fixture(name)})
+        return flow.build_lock_graph(project, scope=[self.PATH])
+
+    def test_cycle_detected(self):
+        g = self._graph("lockorder_bad.py")
+        pairs = g.edge_pairs()
+        # the witness-named lock and the derived identity both node
+        assert any(a == "fx.alpha" for a, _ in pairs) or \
+            any(b == "fx.alpha" for _, b in pairs)
+        cycles = g.cycles()
+        assert cycles, pairs
+        assert set(cycles[0]) == {"fx.alpha",
+                                  "veles_tpu/serve/_fx_lockorder"
+                                  .replace("veles_tpu/", "")
+                                  .replace("/", ".") + "._beta"}
+
+    def test_clean_graph_is_acyclic(self):
+        g = self._graph("lockorder_clean.py")
+        assert g.edge_pairs(), "edges expected from nesting"
+        assert g.cycles() == []
+
+    def test_checked_in_law_is_cycle_free_and_current(self):
+        """The committed locking law parses, has no cycle, and
+        matches a fresh static build — the PR's reviewable statement
+        of the threading model."""
+        payload = flow.load_lock_order(LAW_PATH)
+        assert payload is not None, "lock_order.json must be present"
+        declared = flow.declared_edges(payload)
+        assert declared, "the serving tier has nested acquisitions"
+        g = flow.LockGraph()
+        for e in declared:
+            g.add_edge(e[0], e[1], "declared")
+        assert g.cycles() == []
+        # every declared lock is witness-named: the runtime witness
+        # and the static law share identities
+        assert all(n.get("witnessed")
+                   for n in payload["nodes"]), payload["nodes"]
+
+
+# -- wire-protocol / thread-lifecycle ----------------------------------
+
+class TestWireProtocol:
+    def _scan(self, name):
+        cfg = Config(wire_modules=["fx/wire.py"])
+        found = scan_source("fx/wire.py", fixture(name), cfg)
+        return [f for f in found if f.rule == "wire-protocol"]
+
+    def test_catches_seeded(self):
+        got = self._scan("wire_bad.py")
+        assert {f.detail for f in got} == \
+            {"modle", "bogus_field", "why_not"}, got
+
+    def test_clean(self):
+        assert self._scan("wire_clean.py") == []
+
+    def test_registry_covers_live_protocol(self):
+        from veles_tpu.serve import protocol
+        for key in ("id", "model", "rows", "deadline_ms", "pred",
+                    "probs", "rows_n", "crc", "expired", "error",
+                    "overloaded", "ready", "hb", "stats", "fleet"):
+            assert protocol.known(key), key
+        assert not protocol.known("jid")   # internal name, not wire
+
+
+class TestThreadLifecycle:
+    def _scan(self, name):
+        cfg = Config(thread_modules=["fx/threads.py"])
+        found = scan_source("fx/threads.py", fixture(name), cfg)
+        return [f for f in found if f.rule == "thread-lifecycle"]
+
+    def test_catches_seeded(self):
+        got = self._scan("thread_bad.py")
+        assert len(got) == 1 and got[0].detail == "thread:straggler"
+
+    def test_clean(self):
+        assert self._scan("thread_clean.py") == []
+
+
+# -- the runtime witness -----------------------------------------------
+
+class TestWitnessUnit:
+    def test_off_by_default_zero_cost(self):
+        """Disabled, the factories return the BARE threading
+        primitives — overhead is zero by construction (same object
+        type, same C fastpath), pinned here by type identity plus a
+        generous timing bound against scheduler noise."""
+        assert not witness.enabled()
+        lk = witness.lock("x")
+        assert type(lk) is type(threading.Lock())
+        cond = witness.condition("x")
+        assert type(cond) is type(threading.Condition())
+
+        def clock(lock, n=20000):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with lock:
+                    pass
+            return time.perf_counter() - t0
+
+        bare = threading.Lock()
+        clock(bare), clock(lk)           # warm both
+        ratio = clock(lk) / max(clock(bare), 1e-9)
+        assert ratio < 1.5, f"disabled witness cost ratio {ratio}"
+
+    def test_edge_recording_and_lifo_release(self, monkeypatch):
+        monkeypatch.setenv(witness.ENV_VAR, "1")
+        witness.reset()
+        a = witness.lock("t.a")
+        b = witness.lock("t.b")
+        c = witness.rlock("t.c")
+        with a:
+            with b:
+                with c:
+                    with c:   # re-entrant: no self edge
+                        pass
+        assert witness.observed_edges() == [
+            ("t.a", "t.b"), ("t.a", "t.c"), ("t.b", "t.c")]
+        # releases unwound: a fresh acquisition records no stale edges
+        witness.reset()
+        with b:
+            pass
+        assert witness.observed_edges() == []
+
+    def test_condition_wait_releases_for_the_wait(self, monkeypatch):
+        monkeypatch.setenv(witness.ENV_VAR, "1")
+        witness.reset()
+        cond = witness.condition("t.cond")
+        hits = []
+
+        def waiter():
+            with cond:
+                hits.append("waiting")
+                cond.wait(2.0)
+                hits.append("woke")
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        for _ in range(200):
+            if hits:
+                break
+            time.sleep(0.01)
+        with cond:           # acquirable only because wait released
+            cond.notify_all()
+        t.join(timeout=5.0)
+        assert hits == ["waiting", "woke"]
+        assert witness.observed_edges() == []   # no nesting happened
+
+    def test_snapshot_roundtrip(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(witness.ENV_VAR, "1")
+        witness.reset()
+        a, b = witness.lock("t.outer"), witness.lock("t.inner")
+        with a:
+            with b:
+                pass
+        path = witness.write_snapshot(str(tmp_path))
+        assert path and os.path.isfile(path)
+        with open(path) as f:
+            data = json.load(f)
+        assert data["edges"] == [
+            {"from": "t.outer", "to": "t.inner", "count": 1}]
+        assert witness.read_snapshots(str(tmp_path)) == [
+            ("t.outer", "t.inner")]
+
+
+class TestWitnessAgainstTheLaw:
+    """The acceptance property: REAL execution under the witness
+    observes only edges the static law declares."""
+
+    def test_in_process_serving_edges_subset_of_law(self,
+                                                    monkeypatch):
+        from veles_tpu import telemetry
+        from veles_tpu.serve.batcher import MicroBatcher
+        from veles_tpu.serve.sentinel import Sentinel
+        monkeypatch.setenv(witness.ENV_VAR, "1")
+        witness.reset()
+
+        mb = MicroBatcher(lambda xb: xb.sum(axis=1), max_batch=8,
+                          max_wait_s=0.002, label="lockstep")
+        futs = [mb.submit(np.ones((2, 4), np.float32))
+                for _ in range(16)]
+        for f in futs:
+            f.result(timeout=10)
+        mb.close()
+
+        class FakeReplica:
+            def __init__(self, i):
+                self.idx = i
+                self.healthy = True
+                self.client = None
+
+        s = Sentinel([FakeReplica(0), FakeReplica(1)],
+                     probe_fn=lambda r, m, rows: (True, "ok"))
+        # a UNIQUE model name so its latency histogram (and its
+        # witnessed lock) is created after arming
+        model = f"lockstep_m{os.getpid()}"
+        h = telemetry.histogram(
+            f"fleet.model.{model}.request_seconds")
+        for _ in range(40):
+            h.record(0.01)
+        s.hedge_threshold_ms(model)
+        time.sleep(0.6)
+        s.hedge_threshold_ms(model)
+        s.close()
+
+        observed = set(witness.observed_edges())
+        declared = flow.declared_edges(
+            flow.load_lock_order(LAW_PATH))
+        assert observed, "the witness recorded nothing"
+        assert observed <= declared, (
+            f"UNDECLARED runtime edges {sorted(observed - declared)}"
+            f" — the static model has a gap; review and run "
+            f"scripts/veleslint.py --sync-lock-order")
+        # the sentinel edge is deterministic here (fresh histogram)
+        assert ("sentinel.health", "telemetry.histogram") in observed
+
+    def test_real_hive_under_witness(self, packages_dir,
+                                     tmp_path):
+        """A real --serve-models subprocess, armed: its lockwitness
+        snapshot must exist and stay inside the law; unioned with the
+        in-process edges this pins >= 3 distinct observed edges."""
+        from veles_tpu.serve.client import HiveClient
+        mdir = str(tmp_path / "metrics")
+        c = HiveClient(
+            {"alpha": packages_dir}, backend="cpu", max_batch=8,
+            max_wait_ms=2.0, heartbeat_every=0.5,
+            metrics_dir=mdir,
+            env={"VELES_LOCK_WITNESS": "1"}, cwd=repo_root(),
+            start_timeout=300.0)
+        try:
+            rows = np.random.default_rng(0).standard_normal(
+                (4, 6, 6, 1)).astype(np.float32)
+            threads = []
+            errs = []
+
+            def one():
+                try:
+                    r = c.request("alpha", rows, timeout=60.0)
+                    assert "probs" in r, r
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            for _ in range(8):
+                t = threading.Thread(target=one)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=120.0)
+            assert not errs, errs
+        finally:
+            c.close()
+        observed = set(witness.read_snapshots(mdir))
+        assert observed, "hive left no lockwitness snapshot"
+        declared = flow.declared_edges(
+            flow.load_lock_order(LAW_PATH))
+        assert observed <= declared, (
+            f"UNDECLARED runtime edges in the hive: "
+            f"{sorted(observed - declared)}")
+        assert ("batcher.queue", "telemetry.histogram") in observed
+        assert ("batcher.queue", "telemetry.registry") in observed
+        # >= 3 distinct edges across the witnessed executions: the
+        # hive's two batcher edges + the in-process sentinel edge
+        # (test above) cover three distinct pairs of the law
+        assert len(declared) >= 3
+
+
+@pytest.fixture(scope="module")
+def packages_dir(tmp_path_factory):
+    """One Forge ensemble package for the witnessed hive."""
+    import test_serve   # pytest puts tests/ on sys.path
+    d = str(tmp_path_factory.mktemp("lockstep_pkgs"))
+    return test_serve._build_package(d, "alpha", 77)["pkg"]

@@ -240,7 +240,9 @@ def test_event_registry_covers_drill_names():
 def test_rule_catalog_is_stable():
     assert rule_names() == [
         "atomic-write", "env-registry", "event-registry",
-        "tracer-hygiene", "exit-code-literals", "lock-discipline"]
+        "tracer-hygiene", "exit-code-literals", "lock-discipline",
+        "thread-lifecycle", "wire-protocol",
+        "lock-order", "blocking-under-lock", "waiter-discipline"]
 
 
 # -- docs + full-repo gate ---------------------------------------------
@@ -286,6 +288,26 @@ def test_cli_single_rule_and_exit_code(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 1
     assert "atomic-write" in out and "bad.py" in out
+
+
+def test_run_lint_changed_only_scoping(tmp_path):
+    """--changed-only semantics: per-file findings report only for
+    the given paths, while law-level findings (the lock-order json)
+    always report — the graph is meaningless piecemeal."""
+    root = tmp_path / "repo"
+    (root / "veles_tpu").mkdir(parents=True)
+    bad = 'def w(p):\n    with open(p, "w") as f:\n        f.write("x")\n'
+    (root / "veles_tpu" / "a.py").write_text(bad)
+    (root / "veles_tpu" / "b.py").write_text(bad)
+    found = run_lint(str(root), Config(), check_docs=False,
+                     only_paths=["veles_tpu/a.py"])
+    per_file = [f for f in found if f.path.endswith(".py")]
+    assert {f.path for f in per_file} == {"veles_tpu/a.py"}
+    assert any(f.rule == "lock-order" and f.detail == "missing"
+               for f in found)
+    full = run_lint(str(root), Config(), check_docs=False)
+    assert {f.path for f in full if f.path.endswith(".py")} == \
+        {"veles_tpu/a.py", "veles_tpu/b.py"}
 
 
 def test_scan_is_fast_enough_for_tier1():

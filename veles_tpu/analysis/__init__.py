@@ -23,11 +23,17 @@ from veles_tpu.analysis.engine import (  # noqa: F401
     check_knob_table,
     load_baseline,
     load_config,
+    load_contexts,
     new_findings,
+    project_findings,
     repo_root,
     repo_scan,
     run_lint,
     scan_source,
     write_baseline,
 )
-from veles_tpu.analysis.rules import RULES, rule_names  # noqa: F401
+from veles_tpu.analysis.rules import (  # noqa: F401
+    PROJECT_RULES,
+    RULES,
+    rule_names,
+)

@@ -38,6 +38,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--sync-docs", action="store_true",
                    help="regenerate the VELES_* knob table in "
                         "docs/guide.md from veles_tpu/knobs.py")
+    p.add_argument("--sync-lock-order", action="store_true",
+                   help="regenerate analysis/lock_order.json (the "
+                        "locking law) and the guide's threading-"
+                        "model table from the live scan; review the "
+                        "diff before committing")
+    p.add_argument("--changed-only", action="store_true",
+                   help="fast inner-loop mode: report per-file "
+                        "findings only for git-changed files (the "
+                        "lock-order law is still checked whole; the "
+                        "full scan stays the tier-1 gate)")
     p.add_argument("--no-docs-check", action="store_true",
                    help="skip the guide knob-table sync check")
     args = p.parse_args(argv)
@@ -54,6 +64,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"veleslint: knob table regenerated in {guide}")
         return 0
 
+    if args.sync_lock_order:
+        from veles_tpu.analysis.concurrency import sync_lock_order
+        contexts = engine.load_contexts(root, config)
+        law = sync_lock_order(root, config, contexts)
+        print(f"veleslint: locking law regenerated in {law} "
+              f"(+ the guide threading-model table)")
+        return 0
+
+    only_paths = None
+    if args.changed_only:
+        only_paths = _git_changed_paths(root)
+        if only_paths is None:
+            print("veleslint: --changed-only needs a git checkout; "
+                  "falling back to the full scan", file=sys.stderr)
+
     baseline_path = os.path.join(root, config.baseline)
     try:
         baseline = engine.load_baseline(baseline_path)
@@ -62,7 +87,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     findings = engine.run_lint(root, config, rules=args.rule,
-                               check_docs=not args.no_docs_check)
+                               check_docs=not args.no_docs_check,
+                               only_paths=only_paths)
 
     if args.write_baseline:
         engine.write_baseline(baseline_path, findings, baseline)
@@ -83,9 +109,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         for f in shown:
             tag = "" if f.key not in baseline else " (baselined)"
             print(f.format() + tag)
-        # staleness is only decidable from a full-rule scan: a
-        # --rule run never produces the other rules' findings
-        stale = [] if args.rule else \
+        # staleness is only decidable from a full scan: a --rule or
+        # --changed-only run never produces the other findings
+        stale = [] if args.rule or only_paths is not None else \
             [k for k in baseline
              if k not in {f.key for f in findings}]
         if stale:
@@ -98,6 +124,30 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{len(findings) - len(new)} baselined, "
               f"{len(baseline)} baseline entr(y/ies)")
     return 1 if new else 0
+
+
+def _git_changed_paths(root: str) -> Optional[List[str]]:
+    """Repo-relative .py paths with uncommitted changes (staged,
+    unstaged, or untracked); None when git is unavailable — the
+    caller falls back to the full scan."""
+    import subprocess
+    try:
+        proc = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=15)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out: List[str] = []
+    for line in proc.stdout.splitlines():
+        path = line[3:].strip()
+        if " -> " in path:          # rename: scan the new name
+            path = path.split(" -> ", 1)[1]
+        path = path.strip('"')
+        if path.endswith(".py"):
+            out.append(path)
+    return out
 
 
 if __name__ == "__main__":

@@ -87,12 +87,40 @@ _DEFAULTS: Dict[str, Any] = {
         "veles_tpu/__main__.py", "veles_tpu/genetics/core.py",
         "veles_tpu/genetics/worker.py", "veles_tpu/genetics/pool.py",
         "scripts/chaos_drill.py"],
-    # lock-discipline applies to the thread-spawning modules
+    # lock-discipline / blocking-under-lock / the lock-order graph
+    # walk apply to the thread-spawning modules
     "lock_modules": [
         "veles_tpu/faults.py", "veles_tpu/telemetry.py",
         "veles_tpu/launcher.py", "veles_tpu/supervisor.py",
         "veles_tpu/web_status.py", "veles_tpu/genetics/pool.py",
-        "veles_tpu/genetics/worker.py"],
+        "veles_tpu/genetics/worker.py",
+        "veles_tpu/serve/batcher.py", "veles_tpu/serve/hive.py",
+        "veles_tpu/serve/client.py", "veles_tpu/serve/residency.py",
+        "veles_tpu/serve/fleet.py", "veles_tpu/serve/router.py",
+        "veles_tpu/serve/sentinel.py"],
+    # waiter-discipline applies to the serve tier + the GA pool
+    "waiter_modules": [
+        "veles_tpu/serve/batcher.py", "veles_tpu/serve/client.py",
+        "veles_tpu/serve/fleet.py", "veles_tpu/serve/hive.py",
+        "veles_tpu/serve/residency.py", "veles_tpu/serve/router.py",
+        "veles_tpu/serve/sentinel.py", "veles_tpu/genetics/pool.py"],
+    # wire-protocol applies to the modules that build JSONL lines
+    "wire_modules": [
+        "veles_tpu/serve/router.py", "veles_tpu/serve/client.py",
+        "veles_tpu/serve/hive.py", "veles_tpu/serve/batcher.py",
+        "veles_tpu/serve/sentinel.py"],
+    # thread-lifecycle applies to every thread-spawning module
+    "thread_modules": [
+        "veles_tpu/faults.py", "veles_tpu/telemetry.py",
+        "veles_tpu/launcher.py", "veles_tpu/supervisor.py",
+        "veles_tpu/web_status.py", "veles_tpu/genetics/pool.py",
+        "veles_tpu/genetics/worker.py",
+        "veles_tpu/serve/batcher.py", "veles_tpu/serve/hive.py",
+        "veles_tpu/serve/client.py", "veles_tpu/serve/fleet.py",
+        "veles_tpu/serve/router.py", "veles_tpu/serve/sentinel.py",
+        "bench.py"],
+    #: the checked-in locking law the lock-order rule verifies
+    "lock_order": "veles_tpu/analysis/lock_order.json",
     # the registries themselves declare names as literals by design
     "registry_exempt": ["veles_tpu/knobs.py", "veles_tpu/events.py"],
     # rules to run (all by default)
@@ -335,11 +363,28 @@ def _iter_files(root: str, config: Config) -> List[str]:
     return out
 
 
+def _scan_ctx(ctx: ModuleContext,
+              rules: Optional[List[str]]) -> List[Finding]:
+    """Per-file rules over one parsed module context."""
+    from veles_tpu.analysis.rules import RULES
+    findings: List[Finding] = []
+    for rule in RULES:
+        if rules and rule.name not in rules:
+            continue
+        for f in rule.check(ctx):
+            if not ctx.waived(f.line, f.rule):
+                findings.append(f)
+    return findings
+
+
 def scan_source(path: str, source: str, config: Optional[Config] = None,
                 rules: Optional[List[str]] = None) -> List[Finding]:
-    """Run the (selected) rules over one in-memory module.  ``path``
-    is the repo-relative path used for scoping and reporting."""
-    from veles_tpu.analysis.rules import RULES
+    """Run the (selected) per-file rules over one in-memory module.
+    ``path`` is the repo-relative path used for scoping and
+    reporting.  The whole-program Lockstep rules (lock-order,
+    blocking-under-lock, waiter-discipline) need every module at once
+    and only run through :func:`run_lint` /
+    :func:`project_findings`."""
     config = config or Config()
     try:
         ctx = ModuleContext(path, source, config)
@@ -348,37 +393,108 @@ def scan_source(path: str, source: str, config: Optional[Config] = None,
                         "syntax", f"does not parse: {e.msg}")]
     selected = rules if rules is not None else \
         (config.rules or None)
-    findings: List[Finding] = []
-    for rule in RULES:
-        if selected and rule.name not in selected:
-            continue
-        for f in rule.check(ctx):
-            if not ctx.waived(f.line, f.rule):
-                findings.append(f)
+    findings = _scan_ctx(ctx, selected)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
-def run_lint(root: Optional[str] = None,
-             config: Optional[Config] = None,
-             rules: Optional[List[str]] = None,
-             check_docs: bool = True) -> List[Finding]:
-    """The full scan: every configured file, plus the docs-sync check
-    of the generated knob table."""
-    root = root or repo_root()
-    config = config or load_config(root)
-    findings: List[Finding] = []
+def load_contexts(root: str, config: Config
+                  ) -> List[ModuleContext]:
+    """Parse every configured file once (parse errors surface as
+    findings through run_lint; unparsable files are skipped here)."""
+    out: List[ModuleContext] = []
     for rel in _iter_files(root, config):
         try:
             with open(os.path.join(root, rel), encoding="utf-8") as f:
                 source = f.read()
         except OSError:
             continue
-        findings += scan_source(rel, source, config, rules)
+        try:
+            out.append(ModuleContext(rel, source, config))
+        except SyntaxError:
+            continue
+    return out
+
+
+def project_findings(contexts: List[ModuleContext], root: str,
+                     config: Config,
+                     rules: Optional[List[str]] = None
+                     ) -> List[Finding]:
+    """The whole-program Lockstep rules over the parsed contexts,
+    inline waivers applied (a project finding anchored in a scanned
+    file honors `# veleslint: disable=...` on its line)."""
+    from veles_tpu.analysis.concurrency import PROJECT_RULES
+    from veles_tpu.analysis.flow import build_project
+    selected = rules if rules is not None else \
+        (config.rules or None)
+    wanted = [r for r in PROJECT_RULES
+              if not selected or r.name in selected]
+    if not wanted:
+        return []
+    project = build_project(contexts)
+    by_path = {ctx.path: ctx for ctx in contexts}
+    findings: List[Finding] = []
+    for rule in wanted:
+        for f in rule.check_project(project, config, root):
+            ctx = by_path.get(f.path)
+            if ctx is not None and ctx.waived(f.line, f.rule):
+                continue
+            findings.append(f)
+    return findings
+
+
+def run_lint(root: Optional[str] = None,
+             config: Optional[Config] = None,
+             rules: Optional[List[str]] = None,
+             check_docs: bool = True,
+             only_paths: Optional[List[str]] = None) -> List[Finding]:
+    """The full scan: per-file rules over every configured file, the
+    whole-program Lockstep rules over the project, and the docs-sync
+    check of the generated knob table.
+
+    ``only_paths`` (the CLI's ``--changed-only`` fast mode) restricts
+    REPORTING to those files: the project is still parsed and the
+    lock-order law still checked whole (the graph is meaningless
+    piecemeal), but per-file and per-function findings outside the
+    set are dropped.  The full scan remains the tier-1 gate."""
+    root = root or repo_root()
+    config = config or load_config(root)
+    selected = rules if rules is not None else \
+        (config.rules or None)
+    only = set(only_paths) if only_paths is not None else None
+    findings: List[Finding] = []
+    contexts: List[ModuleContext] = []
+    for rel in _iter_files(root, config):
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        try:
+            ctx = ModuleContext(rel, source, config)
+        except SyntaxError as e:
+            if only is None or rel in only:
+                findings.append(Finding(
+                    "parse-error", rel, e.lineno or 0, 0, "syntax",
+                    f"does not parse: {e.msg}"))
+            continue
+        contexts.append(ctx)
+        if only is not None and rel not in only:
+            continue
+        findings += _scan_ctx(ctx, selected)
+    for f in project_findings(contexts, root, config, rules):
+        if only is not None and f.path in only and \
+                f.path.endswith(".py"):
+            findings.append(f)
+        elif only is None or not f.path.endswith(".py"):
+            # law-level findings (lock_order.json drift/cycles,
+            # guide table) always report — the graph is global
+            findings.append(f)
     if check_docs and (rules is None or "env-registry" in rules):
         doc = check_knob_table(root, config)
         if doc is not None:
             findings.append(doc)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 

@@ -419,6 +419,14 @@ class LockDisciplineRule:
         return out
 
 
+from veles_tpu.analysis.concurrency import (  # noqa: E402 — the
+    # concurrency module needs Finding/ModuleContext from engine, so
+    # it cannot be imported before them
+    PROJECT_RULES,
+    ThreadLifecycleRule,
+    WireProtocolRule,
+)
+
 RULES = [
     AtomicWriteRule(),
     EnvRegistryRule(),
@@ -426,8 +434,12 @@ RULES = [
     TracerHygieneRule(),
     ExitCodeLiteralsRule(),
     LockDisciplineRule(),
+    ThreadLifecycleRule(),
+    WireProtocolRule(),
 ]
 
 
 def rule_names() -> List[str]:
-    return [r.name for r in RULES]
+    """Every rule, per-file and whole-program alike (the CLI's
+    --rule choices and the guide's catalog order)."""
+    return [r.name for r in RULES] + [r.name for r in PROJECT_RULES]

@@ -1,0 +1,316 @@
+"""Lockstep's runtime half: the lock-order witness.
+
+The static side (veles_tpu/analysis/flow.py + the ``lock-order``
+rule) derives the repo's lock acquisition graph from the AST and
+checks it in as ``analysis/lock_order.json`` — the reviewed statement
+of the locking law.  A static model is only worth what it is checked
+against, so this module watches the REAL locks at runtime: when
+``$VELES_LOCK_WITNESS=1``, every instrumented acquire records
+``(already-held lock, acquired lock)`` pairs into a process-wide
+table, and a tier-1 test asserts every observed edge is declared in
+``lock_order.json`` — in both directions the comparison is meaningful
+(an observed-but-undeclared edge is a model gap; a declared cycle is a
+latent deadlock the witness would eventually walk into).
+
+Instrumentation is by construction, not by patching: the
+thread-spawning modules create their locks through the factories here
+(``witness.lock("batcher.queue")`` instead of a bare
+``threading.Lock()``), which also gives every lock the canonical NAME
+the static analyzer and the checked-in law share.  Cost when the knob
+is off: the factories return the bare ``threading`` primitive — the
+serving hot path pays literally nothing (pinned by a type-identity
+test).  Cost when on: one thread-local list append per acquire plus a
+dict upsert under a private leaf lock.
+
+The table is telemetry-backed (``lockstep.*`` gauges/counters) and
+flushed next to the Sightline snapshot: ``telemetry.flush()`` calls
+:func:`write_snapshot`, which drops an atomic
+``lockwitness-<pid>.json`` into the metrics dir, so a witnessed
+subprocess fleet leaves one observation file per process for the
+subset assertion to union.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+#: declared in veles_tpu/knobs.py (declaration, not routing, is the
+#: registry contract); read directly so this module stays import-light
+ENV_VAR = "VELES_LOCK_WITNESS"
+
+_tls = threading.local()
+
+#: observed (holder, acquired) -> count; the witness's OWN lock is a
+#: bare primitive and a leaf by construction (nothing is acquired
+#: under it), so it can never participate in an order violation — and
+#: it is deliberately NOT itself witnessed
+_table_lock = threading.Lock()
+_edges: Dict[Tuple[str, str], int] = {}
+_acquire_count = 0
+
+
+def enabled(environ: Optional[Dict[str, str]] = None) -> bool:
+    """Is the witness armed?  Checked at lock CREATION time — an
+    armed process instruments every lock it makes from then on; a
+    disarmed one pays nothing, ever."""
+    env = os.environ if environ is None else environ
+    raw = env.get(ENV_VAR, "")
+    return bool(raw) and raw != "0"
+
+
+def _held() -> List[str]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _suppressed() -> bool:
+    return bool(getattr(_tls, "busy", False))
+
+
+def _after_acquire(name: str) -> None:
+    """Record the (held -> acquired) edges and push the name.  This
+    runs while the acquired lock IS held, so it must never call into
+    telemetry (whose own locks are witnessed — a gauge registration
+    here would re-acquire the very lock being recorded and deadlock);
+    the lockstep gauges are published from :func:`publish_metrics`
+    on the flush path instead."""
+    global _acquire_count
+    if _suppressed():
+        return
+    held = _held()
+    with _table_lock:
+        _acquire_count += 1
+        for holder in held:
+            if holder == name:
+                continue   # re-entrant RLock: not an order edge
+            key = (holder, name)
+            _edges[key] = _edges.get(key, 0) + 1
+    held.append(name)
+
+
+def _after_release(name: str) -> None:
+    if _suppressed():
+        return
+    held = _held()
+    # remove the LAST occurrence: nested reacquisition unwinds LIFO
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == name:
+            del held[i]
+            return
+
+
+def publish_metrics() -> None:
+    """Surface the table through the Sightline gauges
+    (``lockstep.edges_observed`` / ``lockstep.acquires``).  Called by
+    :func:`write_snapshot` — i.e., next to the telemetry flush — at a
+    point where the calling thread holds no witnessed lock; skipped
+    (and recording suppressed) otherwise, because the gauge
+    registration itself takes telemetry's witnessed registry lock."""
+    if _suppressed() or _held():
+        return
+    _tls.busy = True
+    try:
+        from veles_tpu import events, telemetry
+        with _table_lock:
+            n_edges = len(_edges)
+            n_acq = _acquire_count
+        telemetry.gauge(events.GAUGE_LOCKSTEP_EDGES).set(n_edges)
+        telemetry.gauge(events.GAUGE_LOCKSTEP_ACQUIRES).set(n_acq)
+    except Exception:  # noqa: BLE001 — the witness must never take
+        pass           # down the run it is observing
+    finally:
+        _tls.busy = False
+
+
+class _WitnessLock:
+    """Recording proxy over a ``threading.Lock``/``RLock``."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str, inner) -> None:
+        self.name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _after_acquire(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        _after_release(self.name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "_WitnessLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _WitnessCondition:
+    """Recording proxy over a ``threading.Condition``.  ``wait``
+    releases the underlying lock for its duration, so the held-set
+    drops the name across the wait and re-records on wakeup."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._inner = threading.Condition()
+
+    def acquire(self, *args):
+        ok = self._inner.acquire(*args)
+        if ok:
+            _after_acquire(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        _after_release(self.name)
+
+    def __enter__(self) -> "_WitnessCondition":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        _after_release(self.name)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            _after_acquire(self.name)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        _after_release(self.name)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            _after_acquire(self.name)
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+
+# -- the factories the instrumented modules call -----------------------
+
+def lock(name: str):
+    """A named mutex: the bare ``threading.Lock()`` when the witness
+    is off (zero overhead by construction), a recording proxy when
+    armed.  ``name`` is the canonical lock identity shared with the
+    static analyzer and ``analysis/lock_order.json``."""
+    if not enabled():
+        return threading.Lock()
+    return _WitnessLock(name, threading.Lock())
+
+
+def rlock(name: str):
+    if not enabled():
+        return threading.RLock()
+    return _WitnessLock(name, threading.RLock())
+
+
+def condition(name: str):
+    if not enabled():
+        return threading.Condition()
+    return _WitnessCondition(name)
+
+
+# -- reading / flushing the table --------------------------------------
+
+def observed_edges() -> List[Tuple[str, str]]:
+    """Every (holder, acquired) pair seen so far, sorted."""
+    with _table_lock:
+        return sorted(_edges)
+
+
+def acquire_count() -> int:
+    with _table_lock:
+        return _acquire_count
+
+
+def reset() -> None:
+    """Clear the table (test isolation)."""
+    global _acquire_count
+    with _table_lock:
+        _edges.clear()
+        _acquire_count = 0
+
+
+def write_snapshot(directory: Optional[str] = None) -> Optional[str]:
+    """Atomically write this process's observation table as
+    ``lockwitness-<pid>.json`` into ``directory`` (default: the
+    Sightline metrics dir).  Called by ``telemetry.flush()`` when the
+    witness is armed, so witnessed subprocesses leave their edges
+    behind for the tier-1 subset assertion.  None when there is
+    nowhere to write or nothing observed."""
+    publish_metrics()
+    if directory is None:
+        directory = os.environ.get("VELES_METRICS_DIR") or None
+    if not directory:
+        return None
+    with _table_lock:
+        if not _edges and not _acquire_count:
+            return None
+        payload = {
+            "pid": os.getpid(),
+            "acquires": _acquire_count,
+            "edges": [{"from": h, "to": a, "count": c}
+                      for (h, a), c in sorted(_edges.items())],
+        }
+    try:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory,
+                            f"lockwitness-{os.getpid()}.json")
+        fd, tmp = tempfile.mkstemp(dir=directory,
+                                   prefix=".lockwitness.",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(payload, f, indent=1)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+    except OSError:
+        return None
+
+
+def read_snapshots(directory: str) -> List[Tuple[str, str]]:
+    """Union of the observed edges across every
+    ``lockwitness-*.json`` under ``directory`` (recursive — fleet
+    replicas write into per-replica child dirs)."""
+    out = set()
+    for dirpath, _dirnames, filenames in os.walk(directory):
+        for fn in filenames:
+            if not (fn.startswith("lockwitness-")
+                    and fn.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8") as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                continue
+            for e in data.get("edges", []):
+                out.add((e["from"], e["to"]))
+    return sorted(out)

@@ -210,6 +210,9 @@ GAUGE_FLEET_DISPATCH_EMA_MS = _gauge("fleet.dispatch_ema_ms")
 GAUGE_FLEET_HEDGE_THRESHOLD_MS = _gauge("fleet.hedge.threshold_ms")
 GAUGE_FLEET_REPLICAS_EJECTED = _gauge("fleet.eject.current")
 
+GAUGE_LOCKSTEP_EDGES = _gauge("lockstep.edges_observed")
+GAUGE_LOCKSTEP_ACQUIRES = _gauge("lockstep.acquires")
+
 GAUGE_GA_LAST_HANG_WAIT = _gauge("ga.last_hang_wait")
 GAUGE_PREEMPT_SNAPSHOT_SECONDS = _gauge("preempt.snapshot_seconds")
 GAUGE_MULTIHOST_PEER_HEARTBEAT_AGE = _gauge(

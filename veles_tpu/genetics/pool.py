@@ -54,6 +54,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from veles_tpu import events, telemetry
+from veles_tpu.analysis import witness
 from veles_tpu.logger import Logger
 
 
@@ -317,7 +318,7 @@ class ChipEvaluatorPool(Logger):
             -> List[Dict[str, Any]]:
         """Fan the host-side staging hook out over the prep threads and
         draw wire ids — the CPU-parallel share of a generation."""
-        lock = threading.Lock()
+        lock = witness.lock("pool.prep")
         # generation tag for the wire (GeneticOptimizer exports it per
         # evaluation round): lets VELES_FAULTS qualifiers and evaluator
         # logs target a specific generation

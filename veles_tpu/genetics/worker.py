@@ -313,6 +313,7 @@ def serve(args) -> int:
     import threading
 
     from veles_tpu import events, faults, telemetry
+    from veles_tpu.analysis import witness
     from veles_tpu.backends import make_device
     from veles_tpu.config import root
     from veles_tpu.logger import setup_logging
@@ -336,7 +337,7 @@ def serve(args) -> int:
 
     # ALL protocol lines go through one lock so the heartbeat thread
     # can never interleave bytes into a result line
-    emit_lock = threading.Lock()
+    emit_lock = witness.lock("worker.emit")
 
     def emit(obj) -> None:
         with emit_lock:

@@ -222,6 +222,14 @@ FLEET_PROBE_INTERVAL = _knob(
 
 # -- observability -----------------------------------------------------
 
+LOCK_WITNESS = _knob(
+    "VELES_LOCK_WITNESS", False, flag,
+    "Arm the Lockstep lock-order witness: locks created through "
+    "analysis/witness.py record (holder -> acquired) pairs, flushed "
+    "as lockwitness-<pid>.json next to the Sightline snapshot; a "
+    "tier-1 test asserts every observed edge is declared in "
+    "analysis/lock_order.json.  Off (the default) the factories "
+    "return bare threading primitives — zero overhead.")
 METRICS_DIR = _knob(
     "VELES_METRICS_DIR", "", str,
     "Arm Sightline persistence: journal-<pid>.jsonl + atomic "

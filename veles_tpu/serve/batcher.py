@@ -34,6 +34,7 @@ from typing import Any, Callable, List, Optional, Tuple
 import numpy as np
 
 from veles_tpu import events, faults, telemetry
+from veles_tpu.analysis import witness
 from veles_tpu.ops import batching
 
 
@@ -88,7 +89,7 @@ class MicroBatcher:
         self.max_batch = int(max_batch)
         self.max_wait_s = max(0.0, float(max_wait_s))
         self.label = label
-        self._cond = threading.Condition()
+        self._cond = witness.condition("batcher.queue")
         self._queue: "deque[_Pending]" = deque()
         #: authoritative per-sample shape when the model declares one;
         #: otherwise pinned by the first request

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, Optional
 import numpy as np
 
 from veles_tpu import events, telemetry
+from veles_tpu.analysis import witness
 
 
 class ReplicaDied(RuntimeError):
@@ -84,8 +85,8 @@ class HiveClient:
         self.proc = subprocess.Popen(
             cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             text=True, bufsize=1, env=run_env, cwd=cwd)
-        self._wlock = threading.Lock()
-        self._cond = threading.Condition()
+        self._wlock = witness.lock("client.wire")
+        self._cond = witness.condition("client.results")
         self._results: Dict[int, Dict[str, Any]] = {}
         #: async collectors (wire id -> callback) — the canary-mirror
         #: path records telemetry without parking a thread per request

@@ -39,6 +39,7 @@ import time
 from typing import Any, Dict, List, Optional, Set
 
 from veles_tpu import events, knobs, telemetry
+from veles_tpu.analysis import witness
 from veles_tpu.logger import Logger
 from veles_tpu.serve.client import HiveClient
 
@@ -133,7 +134,7 @@ class Replica(Logger):
         self.death_kind: Optional[str] = None
         self._consecutive_deaths = 0
         self.next_respawn_at = 0.0
-        self._lock = threading.Lock()
+        self._lock = witness.lock("fleet.replica")
         #: router-side in-flight requests (the bounded router queue)
         self.inflight = 0
         #: EMAs polled from the replica's live stats by the monitor

@@ -62,6 +62,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from veles_tpu import events, faults, knobs, telemetry
+from veles_tpu.analysis import witness
 from veles_tpu.serve.batcher import DeadlineExpired
 from veles_tpu.supervisor import EXIT_PREEMPTED
 
@@ -225,7 +226,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # ones right back — that IS the steady-state policy at work
         residency.ensure(name)
 
-    emit_lock = threading.Lock()
+    emit_lock = witness.lock("hive.emit")
 
     def emit(obj: Dict[str, Any]) -> None:
         with emit_lock:
@@ -274,6 +275,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         n = 0
         while not hb_stop.wait(args.heartbeat_every):
             emit({"hb": n, "pid": os.getpid()})
+            telemetry.maybe_flush()
             n += 1
 
     if args.heartbeat_every > 0:

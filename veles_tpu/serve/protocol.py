@@ -1,0 +1,115 @@
+"""The central registry of JSONL wire-protocol keys.
+
+Every field name that crosses a serving-tier pipe — hive and fleet
+hello lines, heartbeats, requests, responses, the stats/fleet
+introspection ops — is declared HERE, the same move knobs.py made for
+env vars and events.py for telemetry names.  PR 10-12 grew the wire
+by hand (``deadline_ms``, ``rows_n``, ``crc``, ``expired``, ...), and
+an ad-hoc key is the emitter/reader typo class: a misspelled field is
+emitted forever and read never, and nothing fails until a drill
+happens to cross it.  Veleslint's ``wire-protocol`` rule
+(veles_tpu/analysis/concurrency.py) flags any undeclared string key
+in a dict literal flowing to the wire in router/client/hive/batcher/
+sentinel.
+
+Declaration, not routing, is the contract (the knobs.py precedent):
+call sites keep writing ``{"id": jid, ...}`` literals — the registry
+exists so the checker can tell a field from a typo, and so THIS file
+is the one place the protocol is enumerated for a reader.
+"""
+
+from __future__ import annotations
+
+from typing import Set
+
+WIRE_KEYS: Set[str] = set()
+
+
+def _k(name: str) -> str:
+    WIRE_KEYS.add(name)
+    return name
+
+
+# -- envelope (every message) ------------------------------------------
+
+K_ID = _k("id")                  #: wire id drawn by the client
+K_OP = _k("op")                  #: stats / fleet / shutdown
+K_ERROR = _k("error")            #: error text (terminal per-request)
+
+# -- requests ----------------------------------------------------------
+
+K_MODEL = _k("model")            #: registered model name
+K_ROWS = _k("rows")              #: f32 sample rows, nested lists
+K_DEADLINE_MS = _k("deadline_ms")  #: absolute unix-epoch deadline
+
+# -- responses ---------------------------------------------------------
+
+K_PRED = _k("pred")              #: argmax per row
+K_PROBS = _k("probs")            #: f32 probability payload
+K_ROWS_N = _k("rows_n")          #: integrity echo: payload row count
+K_CRC = _k("crc")                #: integrity echo: crc32 of clean f32
+K_EXPIRED = _k("expired")        #: dropped past deadline_ms, unanswered
+K_OVERLOADED = _k("overloaded")  #: admission-control shed
+K_EST_MS = _k("est_ms")          #: estimated completion behind a shed
+K_TIMEOUT = _k("timeout")        #: router-side deadline exceeded
+
+# -- hello lines -------------------------------------------------------
+
+K_READY = _k("ready")
+K_PID = _k("pid")
+K_BACKEND = _k("backend")
+K_PLATFORM = _k("platform")
+K_MODELS = _k("models")
+K_MAX_BATCH = _k("max_batch")
+K_MAX_WAIT_MS = _k("max_wait_ms")
+K_MEMBERS = _k("members")        #: per-model: ensemble member count
+K_PARAM_BYTES = _k("param_bytes")
+K_RESIDENT = _k("resident")
+K_VERSION = _k("version")
+# fleet hello extras
+K_FLEET = _k("fleet")            #: replica count (hello) / status (op)
+K_REPLICA_PIDS = _k("replica_pids")
+K_PLACEMENT = _k("placement")
+K_CANARIES = _k("canaries")
+K_OF = _k("of")                  #: canary: primary model name
+K_FRACTION = _k("fraction")      #: canary: mirrored traffic fraction
+K_SLO_P99_MS = _k("slo_p99_ms")
+K_MAX_INFLIGHT = _k("max_inflight")
+
+# -- heartbeats --------------------------------------------------------
+
+K_HB = _k("hb")                  #: heartbeat sequence number
+
+# -- introspection (op=stats / op=fleet) -------------------------------
+
+K_STATS = _k("stats")
+K_REPLICAS = _k("replicas")
+K_REPLICA = _k("replica")
+K_HEALTHY = _k("healthy")
+K_INFLIGHT = _k("inflight")
+K_ROUTED = _k("routed")
+K_DEATHS = _k("deaths")
+K_EMA_DISPATCH_MS = _k("ema_dispatch_ms")
+K_DEADLINE_MS_CFG = _k("deadline_ms")  # shared with requests
+K_HEDGE_RATE = _k("hedge_rate")
+K_SENTINEL = _k("sentinel")
+# the sentinel's per-replica health row (router op=fleet)
+K_STATE = _k("state")
+K_HEALTH_SCORE = _k("health_score")
+K_STRIKES = _k("strikes")
+K_HEDGE_WINS = _k("hedge_wins")
+K_HEDGE_LOSSES = _k("hedge_losses")
+K_PROBE_OK_STREAK = _k("probe_ok_streak")
+K_PROBE_FAILS = _k("probe_fails")
+K_EJECTIONS = _k("ejections")
+K_REINSTATEMENTS = _k("reinstatements")
+K_LATENCY_EMA_MS = _k("latency_ema_ms")
+
+
+def known(key: str) -> bool:
+    """Is ``key`` a declared wire-protocol field?"""
+    return key in WIRE_KEYS
+
+
+def all_keys() -> frozenset:
+    return frozenset(WIRE_KEYS)

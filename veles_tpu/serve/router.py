@@ -64,6 +64,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from veles_tpu import events, knobs, telemetry
+from veles_tpu.analysis import witness
 from veles_tpu.logger import Logger
 from veles_tpu.serve.client import ReplicaDied
 from veles_tpu.serve.fleet import PlacementPolicy, Replica, ReplicaSet
@@ -178,7 +179,7 @@ class FleetRouter(Logger):
             {name: self.hello_models.get(name, {})
              .get("param_bytes", 0) for name in self.models},
             self.n_replicas)
-        self._lock = threading.Lock()
+        self._lock = witness.lock("router.state")
         self._routed = [0] * self.n_replicas
         self._mirror_acc: Dict[str, float] = {}
         self._closed = False
@@ -380,16 +381,19 @@ class FleetRouter(Logger):
         try:
             msg = primary.client.wait_for(
                 jid, timeout=max(0.001, min(hedge_thr_s, remain_s)))
+            primary.release()
+            return evaluate(primary, msg)
         except TimeoutError:
-            msg = None
+            pass   # outlived the hedge threshold: fall through
         except ReplicaDied:
+            # the reader already failed every waiter, but the wire id
+            # must still be retired — uniform waiter discipline, and
+            # a respawned client can never collide with it
+            primary.client.cancel(jid)
             primary.release()
             primary.mark_dead()
             self.sentinel.record_died(primary)
             return {"error": "replica died", "model": model}, "died"
-        if msg is not None:
-            primary.release()
-            return evaluate(primary, msg)
         # -- the request outlived the hedge threshold -----------------
         remain_s = (deadline_ms - time.time() * 1000.0) / 1000.0
         if remain_s <= 0:
@@ -420,6 +424,7 @@ class FleetRouter(Logger):
                 self.sentinel.record_timeout(primary)
                 return timeout_resp()
             except ReplicaDied:
+                primary.client.cancel(jid)
                 primary.release()
                 primary.mark_dead()
                 self.sentinel.record_died(primary)
@@ -442,14 +447,18 @@ class FleetRouter(Logger):
         try:
             hjid = peer.client.submit(model, rows,
                                       deadline_ms=deadline_ms)
-            outstanding[(peer.idx, hjid)] = peer
-            peer.client.collect_async(
-                hjid, lambda m, e, rep=peer, j=hjid:
-                results.put((rep, j, m, e)))
         except ReplicaDied:
             peer.release()
             peer.mark_dead()
             self.sentinel.record_died(peer)
+        else:
+            # registered ONLY after the submit succeeded: the except
+            # arm above covers exactly the risky call, so a hedge id
+            # can never be created and then forgotten
+            outstanding[(peer.idx, hjid)] = peer
+            peer.client.collect_async(
+                hjid, lambda m, e, rep=peer, j=hjid:
+                results.put((rep, j, m, e)))
 
         def drop_outstanding(score_timeout: bool) -> None:
             for (idx, ojid), rep in list(outstanding.items()):
@@ -538,6 +547,7 @@ class FleetRouter(Logger):
             r.client.cancel(jid)
             return False, f"no answer in {timeout_s:.1f}s"
         except ReplicaDied as e:
+            r.client.cancel(jid)
             return False, f"died: {e}"
         if "error" in msg:
             return False, f"error: {msg['error']}"
@@ -785,7 +795,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"--serve-fleet: {e}", file=sys.stderr)
         return 2
 
-    emit_lock = threading.Lock()
+    emit_lock = witness.lock("router.emit")
 
     def emit(obj: Dict[str, Any]) -> None:
         with emit_lock:
@@ -824,6 +834,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         n = 0
         while not hb_stop.wait(args.heartbeat_every):
             emit({"hb": n, "pid": os.getpid()})
+            telemetry.maybe_flush()
             n += 1
 
     if args.heartbeat_every > 0:
@@ -883,7 +894,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             resp["id"] = jid
             emit(resp)
 
-        pool.submit(_route)
+        def _route_done(f, jid=jid) -> None:
+            # a routing thread that died past router.request (broken
+            # stdout, encode error) must not vanish into the
+            # executor: count it, so "answers stopped" has a signal
+            err = f.exception()
+            if err is not None:
+                telemetry.counter(
+                    events.CTR_FLEET_REQUEST_ERRORS).inc()
+                print(f"fleet: request {jid} route thread died: "
+                      f"{type(err).__name__}: {err}",
+                      file=sys.stderr)
+
+        pool.submit(_route).add_done_callback(_route_done)
         return True
 
     rc = 0

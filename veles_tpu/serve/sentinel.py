@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from veles_tpu import events, knobs, telemetry
+from veles_tpu.analysis import witness
 from veles_tpu.logger import Logger
 
 STATE_HEALTHY = "healthy"
@@ -160,7 +161,7 @@ class Sentinel(Logger):
             if probe_interval is not None \
             else float(knobs.get(knobs.FLEET_PROBE_INTERVAL))
         self.probe_backoff_cap = float(probe_backoff_cap)
-        self._lock = threading.Lock()
+        self._lock = witness.lock("sentinel.health")
         self.health: Dict[int, ReplicaHealth] = {
             r.idx: ReplicaHealth(r.idx) for r in replicas}
         self._requests_seen = 0

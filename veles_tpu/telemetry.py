@@ -69,6 +69,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from veles_tpu.analysis import witness
+
 ENV_DIR = "VELES_METRICS_DIR"
 
 #: histogram bucket layout: log-spaced, 32 per decade over
@@ -104,7 +106,7 @@ class Counter:
     def __init__(self, name: str) -> None:
         self.name = name
         self.value = 0
-        self._lock = threading.Lock()
+        self._lock = witness.lock("telemetry.counter")
 
     def inc(self, n: float = 1) -> None:
         if not _enabled:
@@ -156,7 +158,7 @@ class Histogram:
         self.min = math.inf
         self.max = -math.inf
         self.buckets: List[int] = [0] * (NBUCKETS + 2)
-        self._lock = threading.Lock()
+        self._lock = witness.lock("telemetry.histogram")
 
     @staticmethod
     def _index(x: float) -> int:
@@ -285,7 +287,7 @@ class Registry:
     (scripts/obs_report.py)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = witness.lock("telemetry.registry")
         self.counters: Dict[str, Counter] = {}
         self.gauges: Dict[str, Gauge] = {}
         self.histograms: Dict[str, Histogram] = {}
@@ -357,7 +359,7 @@ _recent: "deque[Dict[str, Any]]" = deque(maxlen=4096)
 
 _dir: Optional[str] = os.environ.get(ENV_DIR) or None
 _journal_file = None
-_journal_lock = threading.Lock()
+_journal_lock = witness.lock("telemetry.journal")
 _last_flush = 0.0
 FLUSH_EVERY = 5.0
 
@@ -517,6 +519,7 @@ def flush() -> Optional[str]:
         with _journal_lock:
             if _journal_file is not None:
                 _journal_file.flush()
+        witness.write_snapshot(d)
         _last_flush = time.monotonic()
         return path
     except OSError:
@@ -528,6 +531,16 @@ def _maybe_flush() -> None:
     if _dir and time.monotonic() - _last_flush > FLUSH_EVERY:
         _last_flush = time.monotonic()   # even on failure: no storms
         flush()
+
+
+def maybe_flush() -> None:
+    """Throttled flush (at most once per FLUSH_EVERY seconds): the
+    periodic pulse long-lived serving processes call from their
+    heartbeat loop, so the on-disk snapshot (and the lock-witness
+    table riding on it) stays fresh even when no journal event fires
+    — a SIGKILLed replica then leaves observations at most one
+    heartbeat window stale."""
+    _maybe_flush()
 
 
 def adopt_child_snapshot(pid: int) -> bool:

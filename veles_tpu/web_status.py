@@ -34,6 +34,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
+from veles_tpu.analysis import witness
 from veles_tpu.logger import Logger
 from veles_tpu.plotting_units import Plotter
 
@@ -58,7 +59,7 @@ _PAGE = """<!DOCTYPE html>
 
 class StatusStore:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = witness.lock("web_status.state")
         self._runs: Dict[str, Dict[str, Any]] = {}
 
     def update(self, run_id: str, data: Dict[str, Any]) -> None:
