@@ -877,6 +877,227 @@ def serve_metric(phase):
         return None
 
 
+def online_metric(phase):
+    """Evergreen online learning (ISSUE 14 acceptance): a REAL
+    ``--serve-models --online`` hive under sustained drifted labeled
+    traffic.  Measures (a) the scavenger's duty cycle — fine-tune
+    steps/sec stolen from the gaps of a bursty closed loop — and the
+    serving p99 with the learner active vs learner-off on the same
+    box (bar: <= 1.2x, zero post-warmup recompiles); (b) the gated
+    promotion: held-out error of the promoted shadow vs the frozen
+    incumbent on the drifted stream; (c) ``online.time_to_serve`` —
+    last fine-tune step to first request served on the promoted
+    params, HBM-to-HBM — against the snapshot->npz->Forge->reload
+    path it replaces (measured here as pack_ensemble + a fresh hive
+    spawn to its first served answer).
+
+    Method note for (a): p99 is compared as the MEDIAN over
+    interleaved 2s sub-windows of two co-resident hives (learner-on
+    and learner-off) — single long windows measured 1.3-1.8x purely
+    from window-ordering noise on the build box (the first window
+    after any pause runs cold), while interleaved medians are stable
+    run to run."""
+    if os.environ.get("BENCH_SKIP_ONLINE"):
+        return None
+    import tempfile
+
+    window = float(os.environ.get("BENCH_ONLINE_WINDOW_SEC", "6"))
+    micro_batch = int(os.environ.get("BENCH_ONLINE_MICRO_BATCH",
+                                     "8"))
+    max_batch = int(os.environ.get("BENCH_ONLINE_MAX_BATCH", "8"))
+    max_wait_ms = float(os.environ.get("BENCH_ONLINE_MAX_WAIT_MS",
+                                       "2"))
+    try:
+        from veles_tpu.datasets import synthetic_classification
+        from veles_tpu.ensemble.packaging import pack_ensemble
+        from veles_tpu.serve.client import HiveClient
+
+        # the chaos/fleet drill model: tiny, 3 members, XLA:CPU
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        from chaos_drill import _fleet_pkg
+
+        tmp = tempfile.mkdtemp(prefix="bench_online_")
+        phase("online: packing the ensemble + measuring the npz "
+              "round-trip it replaces")
+        t0 = time.perf_counter()
+        pkg, oracle = _fleet_pkg(tmp)
+        pack_sec = time.perf_counter() - t0
+        # the OLD model-update path: a new package reloads through a
+        # fresh serving process; clock pack + spawn + first answer
+        t0 = time.perf_counter()
+        c0 = HiveClient({"m": pkg}, backend="cpu",
+                        max_batch=max_batch, max_wait_ms=max_wait_ms,
+                        cwd=os.path.dirname(os.path.abspath(
+                            __file__)))
+        train, _valid, _ = synthetic_classification(
+            64, 16, (6, 6, 1), n_classes=3, seed=5)
+        xs, ys = train
+        assert "probs" in c0.request("m", xs[:1], timeout=120)
+        npz_roundtrip_sec = pack_sec + time.perf_counter() - t0
+
+        def bursty_window(client, seconds, labeled):
+            """One bursty closed loop (5 requests back-to-back, then
+            a 10ms lull) — live traffic has gaps; the gaps are the
+            resource the scavenger exists to steal."""
+            st0 = client.stats()
+            n = 0
+            i = 0
+            t_end = time.perf_counter() + seconds
+            while time.perf_counter() < t_end:
+                for _ in range(5):
+                    j = i % len(xs)
+                    i += 1
+                    lab = [int((ys[j] + 1) % 3)] if labeled else None
+                    r = client.wait_for(client.submit(
+                        "m", xs[j][None], label=lab), timeout=60)
+                    assert "error" not in r, r
+                    n += 1
+                time.sleep(0.01)
+            st1 = client.stats()
+            lat = _serve_hist_window(
+                st1["histograms"].get("serve.request_seconds"),
+                st0["histograms"].get("serve.request_seconds"))
+            return st0, st1, lat, n
+
+        mdir = os.path.join(tmp, "metrics")
+        env = {
+            "VELES_ONLINE_MICRO_BATCH": str(micro_batch),
+            # a gate round costs several step-lengths of chip time:
+            # space the rounds out so serving pays for one rarely
+            "VELES_ONLINE_MIN_STEPS": "48",
+            "VELES_ONLINE_LR_SCALE": "1.0",
+            "VELES_ONLINE_PROMOTE_MARGIN": "5.0",
+            "VELES_ONLINE_HOLDOUT_EVERY": "6",
+            # parasitic settings: step only in REAL lulls (4ms quiet),
+            # and rest 9x each step's cost — learning throughput is
+            # worth nothing if it becomes the serving tail
+            "VELES_ONLINE_IDLE_MS": "4",
+            "VELES_ONLINE_DUTY": os.environ.get(
+                "BENCH_ONLINE_DUTY", "0.1"),
+            "VELES_FAULTS": "",
+        }
+        phase("online: spawning the learning hive")
+        c = HiveClient({"m": pkg}, backend="cpu",
+                       max_batch=max_batch, max_wait_ms=max_wait_ms,
+                       online=True, metrics_dir=mdir, env=env,
+                       cwd=os.path.dirname(os.path.abspath(
+                           __file__)))
+        try:
+            assert c.hello.get("online") is True
+            assert "probs" in c.request("m", xs[:1], timeout=120)
+            phase("online: warm-up (first scavenged step compiles)")
+            deadline = time.monotonic() + 120
+            i = 0
+            while time.monotonic() < deadline:
+                j = i % len(xs)
+                i += 1
+                c.wait_for(c.submit("m", xs[j][None],
+                                    label=[int((ys[j] + 1) % 3)]),
+                           timeout=60)
+                if i % 8 == 0:
+                    if c.stats()["counters"].get("online.steps",
+                                                 0) > 0:
+                        break
+                    time.sleep(0.05)
+
+            rounds = max(1, int(window / 2.0))
+            phase(f"online: {rounds}x interleaved 2s p99 windows, "
+                  f"learner-off vs learner-on")
+            p99s_off, p99s_on = [], []
+            steps_w = 0
+            n_on = 0
+            recompiles = 0
+            for _r in range(rounds):
+                _, _, lat_off, _n = bursty_window(c0, 2.0, False)
+                p99s_off.append(1000.0 * (lat_off.quantile(0.99)
+                                          or 0.0))
+                st0, st1, lat_on, n_w = bursty_window(c, 2.0, True)
+                p99s_on.append(1000.0 * (lat_on.quantile(0.99)
+                                         or 0.0))
+                n_on += n_w
+                c0w, c1w = st0["counters"], st1["counters"]
+                steps_w += c1w.get("online.steps", 0) - \
+                    c0w.get("online.steps", 0)
+                recompiles += c1w.get("serve.compiles", 0) - \
+                    c0w.get("serve.compiles", 0)
+            p99_off = float(np.median(p99s_off))
+            p99_on = float(np.median(p99s_on))
+            window_on = 2.0 * rounds
+            c0.close()
+
+            phase("online: driving drift to promotion")
+            deadline = time.monotonic() + 180
+            row = None
+            while time.monotonic() < deadline:
+                for _ in range(8):
+                    j = i % len(xs)
+                    i += 1
+                    c.wait_for(c.submit(
+                        "m", xs[j][None],
+                        label=[int((ys[j] + 1) % 3)]), timeout=60)
+                row = c.learn().get("m")
+                if row and row["promotions"] >= 1:
+                    break
+                time.sleep(0.05)
+            assert row and row["promotions"] >= 1, row
+            # one request on the promoted params pins time_to_serve
+            assert "probs" in c.request("m", xs[:1], timeout=60)
+            row = c.learn()["m"]
+            st_end = c.stats()
+        finally:
+            c.close()
+            if c0.proc.poll() is None:
+                c0.close()
+
+        steps_total = st_end["counters"].get("online.steps", 0)
+        step_sec_total = st_end["counters"].get("online.step_seconds",
+                                                0.0)
+        out = {
+            "online_steps_total": int(steps_total),
+            "online_steps_in_window": int(steps_w),
+            "online_steps_per_sec_window": round(
+                steps_w / window_on, 2),
+            "online_step_ms_avg": round(
+                1000.0 * step_sec_total / steps_total, 2)
+            if steps_total else None,
+            "online_tapped_rows": int(st_end["counters"].get(
+                "online.tapped_rows", 0)),
+            "online_labeled_rows": int(st_end["counters"].get(
+                "online.labeled_rows", 0)),
+            "online_steps_skipped_busy": int(st_end["counters"].get(
+                "online.steps_skipped_busy", 0)),
+            "online_promotions": int(row["promotions"]),
+            "online_rollbacks": int(row["rollbacks"]),
+            "online_shadow_error_pct": row["shadow_error_pct"],
+            "online_incumbent_error_pct": row["incumbent_error_pct"],
+            "online_time_to_serve_ms": row["time_to_serve_ms"],
+            "online_npz_roundtrip_sec": round(npz_roundtrip_sec, 2),
+            "online_p99_ms_learner_on": round(p99_on, 3),
+            "online_p99_ms_learner_off": round(p99_off, 3),
+            "online_p99_ratio": round(p99_on / max(p99_off, 1e-9), 3),
+            "online_recompiles_post_warmup": int(recompiles),
+            "online_qps_window": round(n_on / window_on, 1),
+            "online_micro_batch": micro_batch,
+            "online_window_sec": window_on,
+            "online_buffer_bytes": int(st_end["gauges"].get(
+                "online.buffer_bytes", 0)),
+            "online_platform": "cpu",
+        }
+        phase(f"online: {out['online_steps_per_sec_window']} "
+              f"steps/s scavenged under load, p99 "
+              f"{out['online_p99_ms_learner_on']}ms vs "
+              f"{out['online_p99_ms_learner_off']}ms learner-off "
+              f"({out['online_p99_ratio']}x), time_to_serve "
+              f"{out['online_time_to_serve_ms']}ms vs npz round-trip "
+              f"{out['online_npz_roundtrip_sec']}s, recompiles "
+              f"{out['online_recompiles_post_warmup']}")
+        return out
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"online metric failed: {e}", file=sys.stderr)
+        return None
+
+
 def fleet_metric(phase):
     """Swarm fleet serving (ISSUE 11 acceptance): sustained QPS vs
     replica count (1/2/4 replicas over the SAME model set, XLA:CPU),
@@ -1742,6 +1963,18 @@ def main() -> None:
                   file=sys.stderr, flush=True)
         print(json.dumps(serve_metric(_phase)), flush=True)
         return
+    if "--online-only" in sys.argv:
+        # fast path: ONLY the Evergreen online-learning phase (one
+        # XLA:CPU --online hive) — the ISSUE 14 acceptance gate
+        # (scavenged duty cycle, p99 ratio, gated promotion,
+        # time_to_serve vs the npz round-trip) without the headline
+        t0 = time.perf_counter()
+
+        def _phase(msg):
+            print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}",
+                  file=sys.stderr, flush=True)
+        print(json.dumps(online_metric(_phase)), flush=True)
+        return
     if "--fleet-only" in sys.argv:
         # fast path: ONLY the Swarm fleet phase (N XLA:CPU replica
         # subprocesses) — the ISSUE 11 acceptance gate (replica-count
@@ -1906,6 +2139,28 @@ def main() -> None:
         "fleet_gray_errors": None,
         "fleet_gray_deadline_ms": None,
         "fleet_platform": None,
+        "online_steps_total": None,
+        "online_steps_in_window": None,
+        "online_steps_per_sec_window": None,
+        "online_step_ms_avg": None,
+        "online_tapped_rows": None,
+        "online_labeled_rows": None,
+        "online_steps_skipped_busy": None,
+        "online_promotions": None,
+        "online_rollbacks": None,
+        "online_shadow_error_pct": None,
+        "online_incumbent_error_pct": None,
+        "online_time_to_serve_ms": None,
+        "online_npz_roundtrip_sec": None,
+        "online_p99_ms_learner_on": None,
+        "online_p99_ms_learner_off": None,
+        "online_p99_ratio": None,
+        "online_recompiles_post_warmup": None,
+        "online_qps_window": None,
+        "online_micro_batch": None,
+        "online_window_sec": None,
+        "online_buffer_bytes": None,
+        "online_platform": None,
         "conv_roofline_minibatch": None,
         "conv_roofline_layers": None,
         "conv_roofline_total_efficiency": None,
@@ -1998,6 +2253,13 @@ def main() -> None:
     fl = fleet_metric(phase)
     if fl:
         record.update(fl)
+    emit()
+
+    phase("measuring online learning (Evergreen, XLA:CPU --online "
+          "hive)")
+    ol = online_metric(phase)
+    if ol:
+        record.update(ol)
     emit()
 
     phase("measuring per-conv roofline (layer_roofline --measure)")

@@ -926,6 +926,142 @@ def drill_hive__garbage_response():
         router.close(kill=True)
 
 
+# -- Evergreen drills (online learning) --------------------------------
+
+def _online_hive(d, fault_env, margin="5.0"):
+    """A REAL --serve-models --online hive over the tiny fleet
+    package, with the learner's knobs tightened for drill speed."""
+    from veles_tpu.serve.client import HiveClient
+    pkg, oracle = _fleet_pkg(d)
+    mdir = os.path.join(d, "metrics")
+    WITNESS_DIRS.append(mdir)
+    env = {
+        "VELES_ONLINE_MICRO_BATCH": "8",
+        "VELES_ONLINE_MIN_STEPS": "4",
+        "VELES_ONLINE_LR_SCALE": "1.0",
+        "VELES_ONLINE_PROMOTE_MARGIN": margin,
+        "VELES_ONLINE_HOLDOUT_EVERY": "6",
+        "VELES_ONLINE_IDLE_MS": "1",
+        "VELES_FAULTS": fault_env,
+    }
+    client = HiveClient({"m": pkg}, backend="cpu", max_batch=8,
+                        max_wait_ms=2, online=True, metrics_dir=mdir,
+                        env=env, cwd=REPO)
+    return client, oracle, mdir
+
+
+def _online_rows():
+    """The packaged model's own training rows + labels (regenerated —
+    synthetic_classification is seed-deterministic)."""
+    from veles_tpu.datasets import synthetic_classification
+    train, _valid, _ = synthetic_classification(
+        64, 16, (6, 6, 1), n_classes=3, seed=5)
+    return train
+
+
+def drill_online__poison_batch():
+    """Corrupted tapped labels (the training slot only — the held-out
+    slice stays honest, as a trusted-slice deployment would keep it)
+    must be CAUGHT BY THE GATE: with clean traffic the incumbent is
+    near-perfect on the held-out slice, the garbage-trained shadow
+    cannot beat it, and nothing is ever promoted."""
+    d = tempfile.mkdtemp(prefix="chaos_online_poison_")
+    client, oracle, mdir = _online_hive(
+        d, "online.poison_batch@slot=train&times=*")
+    try:
+        xs, _ys = _online_rows()
+        deadline = time.monotonic() + 90
+        row = None
+        i = 0
+        while time.monotonic() < deadline:
+            for _ in range(8):
+                x = xs[i % len(xs)][None]
+                i += 1
+                # CLEAN labels: the ensemble's own answer — the
+                # incumbent cannot be beaten on this distribution
+                lab = [int(np.argmax(oracle(x), axis=-1)[0])]
+                r = client.wait_for(
+                    client.submit("m", x, label=lab), timeout=60)
+                assert "error" not in r, r
+            row = client.learn().get("m")
+            if row and row["steps"] >= 12 and \
+                    row["shadow_error_pct"] is not None:
+                break
+            time.sleep(0.05)
+        assert row and row["steps"] >= 12, row
+        assert row["shadow_error_pct"] is not None, row
+        assert row["promotions"] == 0, \
+            f"poisoned training labels were PROMOTED: {row}"
+        gates = journal_events_from_dir(mdir, events.EV_ONLINE_GATE)
+        assert gates, "no online.gate round in the journal"
+        assert all(g["verdict"] != "promote" for g in gates), gates
+        return {"steps": row["steps"],
+                "shadow_error_pct": row["shadow_error_pct"],
+                "incumbent_error_pct": row["incumbent_error_pct"],
+                "promotions": 0,
+                "journal_event": events.EV_ONLINE_GATE}
+    finally:
+        client.close()
+
+
+def drill_online__swap_mid_request():
+    """Promotion races live dispatches (the injected stall widens the
+    swap window to 0.5s while a closed loop hammers the model): every
+    answer over the whole drill must equal the frozen-package oracle
+    or the ONE post-promotion answer — a third distinct payload would
+    be torn params."""
+    d = tempfile.mkdtemp(prefix="chaos_online_swap_")
+    client, oracle, mdir = _online_hive(
+        d, "online.swap_mid_request@model=m&seconds=0.5")
+    try:
+        xs, ys = _online_rows()
+        probe = xs[:2]
+        want_old = oracle(probe)
+        answers = []
+        deadline = time.monotonic() + 90
+        i = 0
+        promoted = False
+        while time.monotonic() < deadline:
+            for _ in range(6):
+                j = i % len(xs)
+                i += 1
+                # drifted truth: the frozen model is consistently
+                # wrong, so the gate has something real to promote
+                lab = [int((ys[j] + 1) % 3)]
+                r = client.wait_for(
+                    client.submit("m", xs[j][None], label=lab),
+                    timeout=60)
+                assert "error" not in r, r
+            r = client.request("m", probe, timeout=60)
+            assert "probs" in r, r
+            answers.append(np.asarray(r["probs"], np.float32))
+            row = client.learn().get("m")
+            if row and row["promotions"] >= 1:
+                promoted = True
+                break
+            time.sleep(0.05)
+        assert promoted, "promotion never fired under the stall"
+        # settle: the post-swap serving answer
+        want_new = np.asarray(
+            client.request("m", probe, timeout=60)["probs"],
+            np.float32)
+        assert np.abs(want_new - want_old).max() >= 1e-4, \
+            "promotion did not change the served params"
+        torn = [a for a in answers
+                if np.abs(a - want_old).max() >= 1e-4
+                and np.abs(a - want_new).max() >= 1e-4]
+        assert not torn, f"{len(torn)} torn answer(s) mid-swap"
+        promos = journal_events_from_dir(mdir,
+                                         events.EV_ONLINE_PROMOTED)
+        assert promos and promos[-1]["model"] == "m", promos
+        row = client.learn()["m"]
+        return {"answers_checked": len(answers), "torn": 0,
+                "time_to_serve_ms": row.get("time_to_serve_ms"),
+                "journal_event": events.EV_ONLINE_PROMOTED}
+    finally:
+        client.close()
+
+
 DRILLS = [
     drill_snapshot__torn_write,
     drill_checkpoint__corrupt,
@@ -939,6 +1075,8 @@ DRILLS = [
     drill_hive__slow_dispatch,
     drill_hive__wedge,
     drill_hive__garbage_response,
+    drill_online__poison_batch,
+    drill_online__swap_mid_request,
 ]
 
 

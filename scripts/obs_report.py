@@ -27,7 +27,8 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 from veles_tpu.obs import (fleet_model_rows, fleet_rows,  # noqa: E402
-                           load_dir, render, render_fleet)
+                           learner_rows, load_dir, render,
+                           render_fleet)
 from veles_tpu.telemetry import Histogram  # noqa: E402
 
 
@@ -64,6 +65,9 @@ def main(argv=None) -> int:
             merged["fleet"] = {
                 "replicas": fleet_rows(args.metrics_dir),
                 "models": fleet_model_rows(reg, events)}
+        learners = learner_rows(reg, events)
+        if learners:
+            merged["learner"] = learners
         print(json.dumps(merged))
         return 0
     if args.fleet:

@@ -86,6 +86,8 @@ _DEFAULTS: Dict[str, Any] = {
         "veles_tpu/launcher.py", "veles_tpu/supervisor.py",
         "veles_tpu/__main__.py", "veles_tpu/genetics/core.py",
         "veles_tpu/genetics/worker.py", "veles_tpu/genetics/pool.py",
+        "veles_tpu/online/tap.py", "veles_tpu/online/buffer.py",
+        "veles_tpu/online/trainer.py", "veles_tpu/online/promote.py",
         "scripts/chaos_drill.py"],
     # lock-discipline / blocking-under-lock / the lock-order graph
     # walk apply to the thread-spawning modules
@@ -97,18 +99,23 @@ _DEFAULTS: Dict[str, Any] = {
         "veles_tpu/serve/batcher.py", "veles_tpu/serve/hive.py",
         "veles_tpu/serve/client.py", "veles_tpu/serve/residency.py",
         "veles_tpu/serve/fleet.py", "veles_tpu/serve/router.py",
-        "veles_tpu/serve/sentinel.py"],
+        "veles_tpu/serve/sentinel.py", "veles_tpu/online/tap.py",
+        "veles_tpu/online/buffer.py", "veles_tpu/online/trainer.py",
+        "veles_tpu/online/promote.py"],
     # waiter-discipline applies to the serve tier + the GA pool
     "waiter_modules": [
         "veles_tpu/serve/batcher.py", "veles_tpu/serve/client.py",
         "veles_tpu/serve/fleet.py", "veles_tpu/serve/hive.py",
         "veles_tpu/serve/residency.py", "veles_tpu/serve/router.py",
-        "veles_tpu/serve/sentinel.py", "veles_tpu/genetics/pool.py"],
+        "veles_tpu/serve/sentinel.py", "veles_tpu/genetics/pool.py",
+        "veles_tpu/online/tap.py", "veles_tpu/online/buffer.py",
+        "veles_tpu/online/trainer.py", "veles_tpu/online/promote.py"],
     # wire-protocol applies to the modules that build JSONL lines
     "wire_modules": [
         "veles_tpu/serve/router.py", "veles_tpu/serve/client.py",
         "veles_tpu/serve/hive.py", "veles_tpu/serve/batcher.py",
-        "veles_tpu/serve/sentinel.py"],
+        "veles_tpu/serve/sentinel.py", "veles_tpu/online/tap.py",
+        "veles_tpu/online/trainer.py", "veles_tpu/online/promote.py"],
     # thread-lifecycle applies to every thread-spawning module
     "thread_modules": [
         "veles_tpu/faults.py", "veles_tpu/telemetry.py",
@@ -118,7 +125,7 @@ _DEFAULTS: Dict[str, Any] = {
         "veles_tpu/serve/batcher.py", "veles_tpu/serve/hive.py",
         "veles_tpu/serve/client.py", "veles_tpu/serve/fleet.py",
         "veles_tpu/serve/router.py", "veles_tpu/serve/sentinel.py",
-        "bench.py"],
+        "veles_tpu/online/trainer.py", "bench.py"],
     #: the checked-in locking law the lock-order rule verifies
     "lock_order": "veles_tpu/analysis/lock_order.json",
     # the registries themselves declare names as literals by design

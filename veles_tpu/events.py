@@ -115,6 +115,11 @@ EV_FLEET_REPLICA_EJECTED = _ev("fleet.eject.replica")
 EV_FLEET_REPLICA_REINSTATED = _ev("fleet.eject.reinstated")
 EV_FLEET_PROBE_RESULT = _ev("fleet.probe.result")
 
+EV_ONLINE_ARMED = _ev("online.armed")
+EV_ONLINE_GATE = _ev("online.gate")
+EV_ONLINE_PROMOTED = _ev("online.promoted")
+EV_ONLINE_ROLLBACK = _ev("online.rollback")
+
 EV_SUPERVISOR_RESTART = _ev("supervisor.restart")
 EV_SUPERVISOR_RESUMED = _ev("supervisor.resumed")
 EV_SUPERVISOR_SHUTDOWN = _ev("supervisor.shutdown")
@@ -174,6 +179,16 @@ CTR_FLEET_PROBES = _ctr("fleet.probe.sent")
 CTR_FLEET_PROBES_OK = _ctr("fleet.probe.ok")
 CTR_FLEET_PROBES_FAILED = _ctr("fleet.probe.fail")
 
+CTR_ONLINE_TAPPED_ROWS = _ctr("online.tapped_rows")
+CTR_ONLINE_LABELED_ROWS = _ctr("online.labeled_rows")
+CTR_ONLINE_LABEL_ORPHANS = _ctr("online.label_orphans")
+CTR_ONLINE_STEPS = _ctr("online.steps")
+CTR_ONLINE_STEP_ROWS = _ctr("online.step_rows")
+CTR_ONLINE_STEP_SECONDS = _ctr("online.step_seconds")
+CTR_ONLINE_STEPS_SKIPPED_BUSY = _ctr("online.steps_skipped_busy")
+CTR_ONLINE_PROMOTIONS = _ctr("online.promotions")
+CTR_ONLINE_ROLLBACKS = _ctr("online.rollbacks")
+
 CTR_EVALUATOR_JOBS = _ctr("evaluator.jobs")
 CTR_EVALUATOR_JOB_ERRORS = _ctr("evaluator.job_errors")
 
@@ -210,6 +225,10 @@ GAUGE_FLEET_DISPATCH_EMA_MS = _gauge("fleet.dispatch_ema_ms")
 GAUGE_FLEET_HEDGE_THRESHOLD_MS = _gauge("fleet.hedge.threshold_ms")
 GAUGE_FLEET_REPLICAS_EJECTED = _gauge("fleet.eject.current")
 
+GAUGE_ONLINE_BUFFER_ROWS = _gauge("online.buffer_rows")
+GAUGE_ONLINE_BUFFER_BYTES = _gauge("online.buffer_bytes")
+GAUGE_ONLINE_TIME_TO_SERVE = _gauge("online.time_to_serve")
+
 GAUGE_LOCKSTEP_EDGES = _gauge("lockstep.edges_observed")
 GAUGE_LOCKSTEP_ACQUIRES = _gauge("lockstep.acquires")
 
@@ -235,6 +254,9 @@ HIST_SERVE_REQUEST_SECONDS = _hist("serve.request_seconds")
 HIST_SERVE_DISPATCH_SECONDS = _hist("serve.dispatch_seconds")
 HIST_SERVE_BATCH_ROWS = _hist("serve.batch_rows")
 HIST_SERVE_WAIT_SECONDS = _hist("serve.wait_seconds")
+HIST_ONLINE_STEP_DISPATCH_SECONDS = _hist(
+    "online.step_dispatch_seconds")
+HIST_ONLINE_GATE_SECONDS = _hist("online.gate_seconds")
 
 # -- journaled spans (event + histogram of the same name) --------------
 
@@ -266,6 +288,9 @@ DYNAMIC_FAMILIES = (
     "fleet.model.<name>.request_seconds",
     "fleet.replica.<i>.health_score",
     "fleet.replica.<i>.hedge_wins",
+    "online.model.<name>.buffer_rows",
+    "online.model.<name>.steps",
+    "online.model.<name>.gate_state",
 )
 
 

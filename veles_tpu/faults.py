@@ -65,6 +65,18 @@ Registered points (the call sites document their context keys):
                             AFTER the integrity checksum was computed
                             from the clean payload (``model``) — the
                             router's crc echo must catch it
+``online.poison_batch``     tapped ground-truth labels are scrambled
+                            deterministically before they enter the
+                            replay buffer (``model``/``slot`` =
+                            train / holdout) — the promotion gate's
+                            held-out slice must catch the poisoned
+                            shadow and never promote it
+``online.swap_mid_request`` the promotion gate stalls between gate
+                            decision and the atomic param swap
+                            (``model``; knob: ``seconds``) while live
+                            dispatches race it — every answer must
+                            stay oracle-clean (old params or new,
+                            never torn)
 ==========================  ==========================================
 
 Determinism: the registry carries no clock and no global RNG — an
@@ -99,6 +111,8 @@ POINTS = frozenset((
     "hive.slow_dispatch",
     "hive.wedge",
     "hive.garbage_response",
+    "online.poison_batch",
+    "online.swap_mid_request",
 ))
 
 _log = logging.getLogger("veles_tpu.faults")

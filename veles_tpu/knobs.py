@@ -185,6 +185,70 @@ FLEET_RESPAWN_BACKOFF = _knob(
     "Initial seconds the fleet monitor backs off before respawning a "
     "dead replica (doubles per consecutive death, capped at 30s).")
 
+# -- online learning (Evergreen) ---------------------------------------
+
+ONLINE = _knob(
+    "VELES_ONLINE", False, flag,
+    "Arm the Evergreen online-learning tier inside a hive "
+    "(--serve-models): tapped live traffic fills a replay buffer, a "
+    "scavenger trainer fine-tunes shadow params in serving idle gaps, "
+    "and the promotion gate hot-swaps them HBM-to-HBM when the "
+    "held-out slice improves past $VELES_ONLINE_PROMOTE_MARGIN.")
+ONLINE_TAP_FRAC = _knob(
+    "VELES_ONLINE_TAP_FRAC", 1.0, float,
+    "Deterministic fraction of admitted hive requests the online tap "
+    "mirrors into the replay buffer (an error-diffusion accumulator, "
+    "not a coin flip — the tapped subsequence is reproducible).")
+ONLINE_BUFFER_ROWS = _knob(
+    "VELES_ONLINE_BUFFER_ROWS", 4096, int,
+    "Replay-buffer capacity in sample rows per learning model "
+    "(reservoir-sampled once full); rows store uint8-quantized when "
+    "the model's ingest codec round-trips them, stacking the PR 2 4x "
+    "on the buffer's residency charge.")
+ONLINE_HOLDOUT_EVERY = _knob(
+    "VELES_ONLINE_HOLDOUT_EVERY", 8, int,
+    "Every Nth labeled tapped request lands in the held-out slice "
+    "the promotion gate scores (never trained on).")
+ONLINE_MICRO_BATCH = _knob(
+    "VELES_ONLINE_MICRO_BATCH", 32, int,
+    "Rows per scavenged fine-tune micro-step — the ONE fixed train "
+    "dispatch shape (compiles once, like the serving micro-batch).")
+ONLINE_MIN_STEPS = _knob(
+    "VELES_ONLINE_MIN_STEPS", 8, int,
+    "Fine-tune steps between promotion-gate evaluations (and before "
+    "the first one).")
+ONLINE_PROMOTE_MARGIN = _knob(
+    "VELES_ONLINE_PROMOTE_MARGIN", 1.0, float,
+    "Held-out error-pct margin the shadow must beat the incumbent by "
+    "before the gate promotes it (the anti-noise hysteresis); a "
+    "shadow WORSE by this margin after a full gate round rolls back "
+    "to the incumbent's params and journals.")
+ONLINE_IDLE_MS = _knob(
+    "VELES_ONLINE_IDLE_MS", 2.0, float,
+    "Milliseconds every serving batcher must have been idle (empty "
+    "queue, nothing in flight) before the scavenger fires a "
+    "fine-tune step — serving latency owns the chip, learning eats "
+    "the gaps.")
+ONLINE_SLO_P99_MS = _knob(
+    "VELES_ONLINE_SLO_P99_MS", 0.0, float,
+    "SLO headroom gate for the scavenger (the PR 11 admission-"
+    "estimator move applied to learning): when the EMA fine-tune "
+    "step cost exceeds this many milliseconds the step is skipped "
+    "even on an idle chip — a step that long would blow the p99 of "
+    "a request arriving under it (0 disables the check).")
+ONLINE_LR_SCALE = _knob(
+    "VELES_ONLINE_LR_SCALE", 0.1, float,
+    "Fine-tune learning-rate scale applied to each gradient unit's "
+    "packaged training rate (online steps nudge a converged model; "
+    "full training rates overshoot).")
+ONLINE_DUTY = _knob(
+    "VELES_ONLINE_DUTY", 0.5, float,
+    "Ceiling on the scavenger's duty cycle (fraction of wall it may "
+    "spend stepping, 0..1): after each step it rests at least "
+    "cost*(1-duty)/duty, so even an all-idle chip keeps host cores "
+    "and GIL mostly free for the serving threads — the lever behind "
+    "the <=1.2x learner-on p99 bar.")
+
 # -- gray-failure defense (Sentinel) -----------------------------------
 
 FLEET_DEADLINE_MS = _knob(

@@ -33,6 +33,8 @@ THROUGHPUT_ROWS = (
      "member-img/s"),
     ("ga", "ga.evaluations", "ga.eval_seconds", "genomes/s"),
     ("serve", "serve.rows", "serve.dispatch_seconds", "rows/s"),
+    ("online learner", "online.step_rows", "online.step_seconds",
+     "rows/s"),
 )
 
 
@@ -218,6 +220,79 @@ def fleet_model_rows(reg: Registry, events):
     return rows
 
 
+def learner_rows(reg: Registry, events):
+    """The Evergreen learner panel rows: one per learning model, fed
+    by the ``online.model.<name>.*`` gauge family (live buffer fill /
+    steps / gate state) and the newest ``online.*`` journal events
+    (the gate's last scored round, promotions, rollbacks)."""
+    from veles_tpu.online.promote import GATE_STATES
+    models = {}
+    for n, g in reg.gauges.items():
+        m = re.match(r"online\.model\.(.+)\.(buffer_rows|steps|"
+                     r"gate_state)$", n)
+        if m:
+            models.setdefault(m.group(1), {})[m.group(2)] = g.value
+    last_gate = {}
+    counts = {}
+    for ev in events:
+        name = ev.get("model")
+        if not name:
+            continue
+        kind = ev.get("event")
+        if kind == "online.gate":
+            last_gate[name] = ev
+        elif kind in ("online.promoted", "online.rollback"):
+            key = "promotions" if kind == "online.promoted" \
+                else "rollbacks"
+            counts.setdefault(name, {"promotions": 0,
+                                     "rollbacks": 0})[key] += 1
+            if kind == "online.promoted":
+                counts[name]["last_promote_ts"] = ev.get("ts")
+            else:
+                counts[name]["last_rollback_ts"] = ev.get("ts")
+    rows = []
+    for name in sorted(set(models) | set(last_gate) | set(counts)):
+        d = models.get(name, {})
+        ev = last_gate.get(name, {})
+        c = counts.get(name, {})
+        code = d.get("gate_state")
+        state = GATE_STATES[int(code)] \
+            if code is not None and 0 <= int(code) < len(GATE_STATES) \
+            else None
+        rows.append({
+            "model": name,
+            "state": state,
+            "buffer_rows": d.get("buffer_rows"),
+            "steps": d.get("steps"),
+            "shadow_error_pct": ev.get("shadow_error_pct"),
+            "incumbent_error_pct": ev.get("incumbent_error_pct"),
+            "promotions": c.get("promotions", 0),
+            "rollbacks": c.get("rollbacks", 0),
+            "last_promote_ts": c.get("last_promote_ts"),
+            "last_rollback_ts": c.get("last_rollback_ts"),
+        })
+    return rows
+
+
+def render_learner(reg: Registry, events) -> str:
+    """The learner panel (empty string when nothing is learning)."""
+    rows = learner_rows(reg, events)
+    if not rows:
+        return ""
+    out = ["-- online learner (Evergreen) --",
+           f"  {'model':<16} {'state':>11} {'buffer':>7} "
+           f"{'steps':>7} {'shadow%':>8} {'incumb%':>8} "
+           f"{'promo':>5} {'rollb':>5}"]
+    for r in rows:
+        out.append(
+            f"  {r['model']:<16} {r['state'] or '-':>11} "
+            f"{_fmt(r['buffer_rows']):>7} {_fmt(r['steps']):>7} "
+            f"{_fmt(r['shadow_error_pct']):>8} "
+            f"{_fmt(r['incumbent_error_pct']):>8} "
+            f"{_fmt(r['promotions']):>5} {_fmt(r['rollbacks']):>5}")
+    return "\n".join(out)
+
+
 def render_fleet(metrics_dir: str) -> str:
     """The fleet view: per-replica rows + the per-model canary split.
     Empty string when ``metrics_dir`` holds no ``replica-*`` child
@@ -320,6 +395,11 @@ def render(metrics_dir: str, reg: Registry, snaps, journals, events,
     if rows:
         out.append("-- derived throughput (per engine-second) --")
         out += rows
+        out.append("")
+
+    learner = render_learner(reg, events)
+    if learner:
+        out.append(learner)
         out.append("")
 
     if events:

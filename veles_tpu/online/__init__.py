@@ -1,0 +1,31 @@
+"""Evergreen: the online-learning tier of the hive.
+
+A served model is frozen at package time; Evergreen un-freezes it
+without ever leaving the serving process (or the chip):
+
+- :mod:`veles_tpu.online.tap` — the traffic tap: a deterministic
+  sampled fraction of admitted request rows (``$VELES_ONLINE_TAP_FRAC``)
+  plus their ground-truth labels (the ``label`` wire field, or a late
+  ``label_of`` join by wire id) mirror into
+- :mod:`veles_tpu.online.buffer` — a bounded reservoir-sampled replay
+  buffer, uint8-quantized through the PR 2 ingest codec when the
+  model's dequant round-trips, its host bytes charged against the
+  serving residency budget;
+- :mod:`veles_tpu.online.trainer` — the scavenger: a fused fine-tune
+  micro-step (the FusedStepRunner train body, vmapped over the
+  ensemble's member axis) fires ONLY when every serving batcher is
+  idle and the SLO headroom check passes, so serving latency owns the
+  chip and learning eats the gaps; every step kind compiles once;
+- :mod:`veles_tpu.online.promote` — the gate: fine-tuned params live
+  as a shadow registration in the ResidencyManager, the tap's
+  held-out slice scores shadow vs incumbent, and a win past
+  ``$VELES_ONLINE_PROMOTE_MARGIN`` hot-swaps the params HBM-to-HBM
+  (atomic pointer swap under the residency lock — no in-flight
+  request ever sees torn params); a loss rolls the shadow back and
+  journals.
+"""
+
+from veles_tpu.online.buffer import ReplayBuffer  # noqa: F401
+from veles_tpu.online.promote import GATE_STATES  # noqa: F401
+from veles_tpu.online.tap import TrafficTap  # noqa: F401
+from veles_tpu.online.trainer import OnlineLearner  # noqa: F401

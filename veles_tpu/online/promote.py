@@ -1,0 +1,138 @@
+"""The promotion gate: shadow params -> the serving dispatcher,
+HBM-to-HBM.
+
+Fine-tuned params are only worth serving if they are measurably
+better, and the proof must come from data the trainer never touched:
+the tap's held-out slice.  Each gate round scores shadow and incumbent
+on that slice through the SAME jitted forward chain and applies a
+symmetric hysteresis margin (``$VELES_ONLINE_PROMOTE_MARGIN``, in
+error-pct points):
+
+- shadow better by >= margin  -> **promote**: the already-device-
+  resident stacked param pytree is handed to the serving engine in one
+  atomic pointer swap under the residency lock — params never visit
+  the host, no recompile happens (the engine's dispatch jit is already
+  warm at the serving shape), and an in-flight dispatch keeps the tree
+  it read while later ones read the new one (never torn — the
+  ``online.swap_mid_request`` chaos drill races dispatches against the
+  swap and asserts oracle-clean answers);
+- shadow worse by >= margin   -> **rollback**: the shadow resets to a
+  device copy of the incumbent's params (momentum cleared) and the
+  regression journals — this is what catches an
+  ``online.poison_batch`` label-poisoned training stream;
+- within the margin            -> keep training.
+
+``online.time_to_serve`` — the ROADMAP item-4 handoff metric, last
+fine-tune step to first request served on the new params — is armed at
+swap time through the engine's next-dispatch hook.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from veles_tpu import events, faults, telemetry
+
+#: gate lifecycle states (numeric code = list index, for the
+#: ``online.model.<name>.gate_state`` gauge family)
+GATE_STATES = ("filling", "training", "promoted", "rolled_back")
+
+
+class PromotionGate:
+    """Per-model gate bookkeeping + the swap itself."""
+
+    def __init__(self, model: str, residency: Any, margin: float,
+                 min_steps: int) -> None:
+        self.model = model
+        self.residency = residency
+        self.margin = float(margin)
+        self.min_steps = max(1, int(min_steps))
+        self.state = "filling"
+        self.last_gate_step = 0
+        #: promote/rollback hysteresis: after a verdict that moved
+        #: params, the gate rests 4 full rounds — back-to-back
+        #: promote/rollback churn on a noisy held-out slice is pure
+        #: param thrash (and its tree copies are serving-latency tax)
+        self.cooldown_until_step = 0
+        self.promotions = 0
+        self.rollbacks = 0
+        self.shadow_error_pct = None
+        self.incumbent_error_pct = None
+        #: monotonic ts of the shadow's last fine-tune step — the
+        #: time_to_serve clock starts here
+        self.last_step_ts = None
+        self.time_to_serve_ms = None
+
+    def due(self, steps: int) -> bool:
+        """Is a gate evaluation due after ``steps`` total steps?"""
+        return steps - self.last_gate_step >= self.min_steps \
+            and steps >= self.cooldown_until_step
+
+    def decide(self, steps: int, shadow_err: float,
+               incumbent_err: float) -> str:
+        """Record one gate round; returns "promote" / "rollback" /
+        "continue"."""
+        self.last_gate_step = steps
+        self.shadow_error_pct = shadow_err
+        self.incumbent_error_pct = incumbent_err
+        if incumbent_err - shadow_err >= self.margin:
+            verdict = "promote"
+        elif shadow_err - incumbent_err >= self.margin:
+            verdict = "rollback"
+        else:
+            verdict = "continue"
+        telemetry.event(events.EV_ONLINE_GATE, model=self.model,
+                        steps=steps,
+                        shadow_error_pct=round(shadow_err, 4),
+                        incumbent_error_pct=round(incumbent_err, 4),
+                        margin=self.margin, verdict=verdict)
+        return verdict
+
+    def promote(self, stacked_params: Any, steps: int) -> None:
+        """Hand the shadow's device-resident params to the serving
+        engine.  The ``online.swap_mid_request`` stall fires BEFORE
+        the residency lock (a drill must widen the race window, not
+        create a blocking-under-lock hazard)."""
+        f = faults.fire("online.swap_mid_request", model=self.model)
+        if f:
+            time.sleep(float(f.get("seconds", 0.25)))
+        t0 = time.perf_counter()
+        engine = self.residency.swap_params(self.model, stacked_params)
+        swap_ms = 1000.0 * (time.perf_counter() - t0)
+        last_step = self.last_step_ts
+
+        def _first_served() -> None:
+            if last_step is None:
+                return
+            dt = time.monotonic() - last_step
+            self.time_to_serve_ms = round(1000.0 * dt, 3)
+            telemetry.gauge(events.GAUGE_ONLINE_TIME_TO_SERVE).set(
+                round(dt, 6))
+
+        engine.notify_next_dispatch(_first_served)
+        self.promotions += 1
+        self.state = "promoted"
+        self.cooldown_until_step = steps + 4 * self.min_steps
+        telemetry.counter(events.CTR_ONLINE_PROMOTIONS).inc()
+        telemetry.event(
+            events.EV_ONLINE_PROMOTED, model=self.model, steps=steps,
+            shadow_error_pct=self.shadow_error_pct,
+            incumbent_error_pct=self.incumbent_error_pct,
+            swap_ms=round(swap_ms, 3))
+
+    def rollback(self, steps: int) -> None:
+        self.rollbacks += 1
+        self.state = "rolled_back"
+        self.cooldown_until_step = steps + 4 * self.min_steps
+        telemetry.counter(events.CTR_ONLINE_ROLLBACKS).inc()
+        telemetry.event(
+            events.EV_ONLINE_ROLLBACK, model=self.model, steps=steps,
+            shadow_error_pct=self.shadow_error_pct,
+            incumbent_error_pct=self.incumbent_error_pct)
+
+    def state_code(self) -> int:
+        try:
+            return GATE_STATES.index(self.state)
+        except ValueError:
+            return -1

@@ -1,0 +1,129 @@
+"""The traffic tap: live request rows -> the replay buffer.
+
+Sits on the hive's admission path (after the engine accepted the
+request, so rejected/misshapen rows never pollute the buffer) and
+mirrors a DETERMINISTIC fraction of requests: an error-diffusion
+accumulator (``acc += frac; tap when acc >= 1``) rather than a coin
+flip, so the tapped subsequence of any request stream is exactly
+reproducible — the property the online-vs-offline training oracle and
+the poison drill both pin.
+
+Labels: a request may carry its ground truth inline (the ``label``
+wire field — one int per row, or one scalar for the whole request) or
+deliver it LATE as a ``{"label_of": <wire id>, "label": [...]}`` line
+once the truth is known (the click/outcome case).  Tapped-but-
+unlabeled rows wait in a bounded FIFO pending map keyed by wire id;
+labels for ids that were never tapped (or already evicted) count
+``online.label_orphans`` and drop — an orphan label is operator
+telemetry, never an error.
+
+Fault point ``online.poison_batch`` (chaos drill): scrambles the
+labels of a tapped batch deterministically before they enter the
+buffer; qualified by ``model`` and ``slot`` (train/holdout) so the
+drill can poison exactly the training stream and prove the gate's
+held-out slice refuses to promote garbage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from veles_tpu import events, faults, telemetry
+from veles_tpu.analysis import witness
+from veles_tpu.online.buffer import ReplayBuffer
+
+#: tapped-but-unlabeled requests a model may hold before the oldest
+#: pending row is evicted (late labels for it then count as orphans)
+PENDING_CAP = 256
+
+
+class TrafficTap:
+    """Per-hive tap over every learning model's admitted traffic."""
+
+    def __init__(self, frac: float) -> None:
+        self.frac = max(0.0, min(1.0, float(frac)))
+        self._lock = witness.lock("online.tap")
+        #: per-model error-diffusion accumulator
+        self._acc: Dict[str, float] = {}
+        #: wire id -> (model, rows) awaiting a late label
+        self._pending: "Dict[Any, Tuple[str, np.ndarray]]" = {}
+        #: model name -> ReplayBuffer (armed by the learner)
+        self.buffers: Dict[str, ReplayBuffer] = {}
+
+    def arm(self, model: str, buffer: ReplayBuffer) -> None:
+        with self._lock:
+            self.buffers[model] = buffer
+            self._acc.setdefault(model, 0.0)
+
+    # -- the admission-path hook ---------------------------------------
+
+    def tap(self, model: str, jid: Any, rows: np.ndarray,
+            label: Optional[Any] = None) -> None:
+        """One admitted request.  Samples deterministically; labeled
+        rows go straight to the buffer, unlabeled ones park for a
+        ``label_of`` join."""
+        with self._lock:
+            buf = self.buffers.get(model)
+            if buf is None or self.frac <= 0.0:
+                return
+            acc = self._acc.get(model, 0.0) + self.frac
+            take = acc >= 1.0
+            if take:
+                acc -= 1.0
+            self._acc[model] = acc
+        if not take:
+            return
+        rows = np.asarray(rows, np.float32)
+        telemetry.counter(events.CTR_ONLINE_TAPPED_ROWS).inc(len(rows))
+        if label is not None:
+            self._admit(model, buf, rows, label)
+            return
+        with self._lock:
+            while len(self._pending) >= PENDING_CAP:
+                # FIFO eviction: dicts preserve insertion order
+                self._pending.pop(next(iter(self._pending)))
+            self._pending[jid] = (model, rows)
+
+    def label_for(self, jid: Any, label: Any) -> bool:
+        """A late ``label_of`` line: join by wire id.  False (and an
+        orphan count) when the id was never tapped or already
+        evicted."""
+        with self._lock:
+            hit = self._pending.pop(jid, None)
+        if hit is None:
+            telemetry.counter(events.CTR_ONLINE_LABEL_ORPHANS).inc()
+            return False
+        model, rows = hit
+        with self._lock:
+            buf = self.buffers.get(model)
+        if buf is None:
+            return False
+        self._admit(model, buf, rows, label)
+        return True
+
+    def _admit(self, model: str, buf: ReplayBuffer,
+               rows: np.ndarray, label: Any) -> None:
+        labels = np.asarray(label, np.int32).reshape(-1)
+        # the slot decision must come BEFORE poison so the fault can
+        # qualify on it; peek via the buffer's routing counter
+        slot = "holdout" if buf.holdout_every > 0 and \
+            (buf._seen + 1) % buf.holdout_every == 0 else "train"
+        if faults.fire("online.poison_batch", model=model, slot=slot):
+            # deterministic garbage labels (the drill's poisoned
+            # stream): same seed -> same corruption, run to run
+            labels = faults.rng("online.poison_batch").integers(
+                0, max(2, int(labels.max()) + 1),
+                size=len(labels) if len(labels) > 1 else len(rows),
+                dtype=np.int32)
+        got = buf.add(rows, labels)
+        assert got == slot, (got, slot)
+        telemetry.counter(events.CTR_ONLINE_LABELED_ROWS).inc(
+            len(rows))
+
+    # -- introspection -------------------------------------------------
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)

@@ -1,0 +1,554 @@
+"""The scavenger trainer: fine-tune in the serving idle gaps.
+
+One thread inside the hive process (which owns the chip — there is
+nobody else to give the idle cycles to) drives, per learning model, a
+fused fine-tune micro-step: the FusedStepRunner train body — forward
+with residuals, evaluator gradient, the per-unit backward/SGD chain —
+``jax.vmap``-ed over the ensemble's stacked MEMBER axis and jitted
+with donated params/opt, so one dispatch advances every member on the
+same replay micro-batch and the step compiles exactly ONCE (fixed
+``$VELES_ONLINE_MICRO_BATCH`` shape; the PR 7 compile-split gauge pins
+zero post-warmup recompiles).
+
+Scheduling is strictly parasitic: a step fires only when EVERY serving
+batcher has been idle (empty queue, nothing in flight) for
+``$VELES_ONLINE_IDLE_MS``, and — the PR 11 admission-estimator move
+turned inward — only when the EMA step cost fits under
+``$VELES_ONLINE_SLO_P99_MS`` (a scavenged step longer than the SLO
+would become the p99 of whatever request arrives beneath it).  Skipped
+opportunities count ``online.steps_skipped_busy``.
+
+Determinism: step k of a model samples its micro-batch with a
+generator seeded from ``(model seed, k)`` against the buffer state at
+a recorded ``buffer.version`` — the (step, version) history makes an
+offline replay of the same tapped rows reproduce the online param
+trajectory f32-exactly (pinned by tests/test_online.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from veles_tpu import events, knobs, telemetry
+from veles_tpu.analysis import witness
+from veles_tpu.logger import Logger
+from veles_tpu.online.buffer import ReplayBuffer
+from veles_tpu.online.promote import PromotionGate
+from veles_tpu.online.tap import TrafficTap
+from veles_tpu.ops import batching
+
+
+class ShadowTrainer:
+    """One model's fine-tune state: the jitted vmapped step/score and
+    the device-resident shadow params it advances.
+
+    Deliberately free of hive plumbing so a test (or an offline
+    oracle replay) can drive it directly: construct from the hosted
+    workflow's unit chain, call :meth:`step` with explicit batches."""
+
+    def __init__(self, forwards: List[Any], gds: List[Any],
+                 evaluator: Any, device: Any, stacked_params: Any,
+                 seed: int, lr_scale: float,
+                 micro_batch: int) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.forwards = list(forwards)
+        self.gds = list(gds)
+        self.evaluator = evaluator
+        self.device = device
+        self.seed = int(seed)
+        self.micro_batch = int(micro_batch)
+        self.n_members = int(next(iter(next(iter(
+            stacked_params.values())).values())).shape[0])
+        #: the shadow's working params: a device-to-device COPY of the
+        #: incumbent's stacked tree (the incumbent keeps serving its
+        #: own; donation below only ever consumes the shadow's)
+        self._params = jax.tree_util.tree_map(jnp.copy, stacked_params)
+        self._opt = self._fresh_opt()
+        #: absolute (n_gd, 2) fine-tune rates: the packaged units'
+        #: training rates scaled down for the nudge regime
+        self._lr = np.asarray(
+            [[gd.learning_rate * lr_scale,
+              gd.learning_rate_bias * lr_scale]
+             if gd is not None else [0.0, 0.0] for gd in self.gds],
+            np.float32)
+        self.steps = 0
+        #: (step index, buffer version) per step — the oracle log
+        self.history: List[Tuple[int, int]] = []
+        self._build()
+
+    def _fresh_opt(self) -> Dict[str, Dict[str, Any]]:
+        opt: Dict[str, Dict[str, Any]] = {}
+        for gd in self.gds:
+            if gd is None or not gd.accumulated_grads:
+                continue
+            opt[gd.name] = {
+                k: self.device.zeros(
+                    (self.n_members,) + tuple(v.shape), np.float32)
+                for k, v in gd.accumulated_grads.items()}
+        return opt
+
+    def _build(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        forwards = self.forwards
+        gds = self.gds
+        evaluator = self.evaluator
+        n_fwd = len(forwards)
+        first_gd = next((i for i, g in enumerate(gds)
+                         if g is not None), -1)
+        seed = self.seed
+        cd = batching.resolve_compute_dtype(None, self.device)
+        mixed = cd != jnp.float32
+        cast = batching.make_caster(cd)
+
+        def member_forward(cparams, x, rc, train):
+            h = x.astype(cd) if mixed else x
+            residuals = []
+            for i, f in enumerate(forwards):
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.key(seed), rc), i) \
+                    if f.stochastic else None
+                h, res = f.apply_fwd(cparams[f.name], h, rng=rng,
+                                     train=train)
+                residuals.append(res)
+            return h, residuals
+
+        def member_step(params, opt, lr, x, labels, mask, rc):
+            # the fused train body, one micro-batch per dispatch —
+            # vmap lifts it over the leading member axis of params/opt
+            cparams = cast(params)
+            out, residuals = member_forward(cparams, x, rc, True)
+            m = evaluator.metrics_fn(out.astype(jnp.float32), labels,
+                                     mask)
+            err = m["err_output"]
+            if mixed:
+                err = err.astype(cd)
+            new_params = dict(params)
+            new_opt = dict(opt)
+            for i in range(n_fwd - 1, -1, -1):
+                f, gd = forwards[i], gds[i]
+                if gd is None:
+                    continue
+                if i == first_gd and gd.can_skip_err_input:
+                    _, grads = gd.backward_from_saved(
+                        cparams[f.name], residuals[i], err,
+                        need_err_input=False)
+                    err_in = None
+                else:
+                    err_in, grads = gd.backward_from_saved(
+                        cparams[f.name], residuals[i], err)
+                if grads:
+                    p, v = gd.update_params(params[f.name], grads,
+                                            opt.get(gd.name, {}),
+                                            rates=(lr[i, 0],
+                                                   lr[i, 1]))
+                    new_params[f.name] = p
+                    if gd.name in opt:
+                        new_opt[gd.name] = v
+                err = err_in
+            metrics = jnp.stack([m["n_err"], m["loss_sum"],
+                                 m["count"]])
+            return new_params, new_opt, metrics
+
+        self._step = jax.jit(
+            jax.vmap(member_step,
+                     in_axes=(0, 0, None, None, None, None, None)),
+            donate_argnums=(0, 1))
+
+        def score(params, acc, x, labels, mask):
+            def fwd(p, h):
+                if mixed:
+                    h = h.astype(cd)
+                for f in forwards:
+                    h, _ = f.apply_fwd(p[f.name], h, rng=None,
+                                       train=False)
+                return h.astype(jnp.float32)
+
+            probs = jax.vmap(fwd, in_axes=(0, None))(cast(params), x)
+            pred = jnp.argmax(jnp.mean(probs, axis=0), axis=-1)
+            wrong = jnp.sum((pred != labels).astype(jnp.float32)
+                            * mask)
+            return acc + jnp.stack([wrong, jnp.sum(mask)])
+
+        self._score = jax.jit(score, donate_argnums=(1,))
+
+    # -- the two dispatch kinds ---------------------------------------
+
+    def step(self, x: np.ndarray, labels: np.ndarray,
+             version: int) -> np.ndarray:
+        """One fine-tune micro-step over an exact micro_batch-shaped
+        batch; returns the fetched (P, 3) [n_err, loss_sum, count]
+        metrics (the fetch is the honest step barrier)."""
+        if len(x) != self.micro_batch:
+            raise ValueError(
+                f"step batch is {len(x)} rows, the fixed shape is "
+                f"{self.micro_batch}")
+        mask = np.ones(self.micro_batch, np.float32)
+        self.history.append((self.steps, int(version)))
+        self._params, self._opt, met = self._step(
+            self._params, self._opt, self._lr, x,
+            np.asarray(labels, np.int32), mask, self.steps)
+        self.steps += 1
+        return np.asarray(met)
+
+    def sample_rng(self, step: Optional[int] = None):
+        """The seeded per-step generator the buffer draw uses — a pure
+        function of (model seed, step index)."""
+        k = self.steps if step is None else int(step)
+        return np.random.default_rng([self.seed, k])
+
+    def error_pct(self, params: Any, x: np.ndarray,
+                  labels: np.ndarray,
+                  abort=None) -> Optional[float]:
+        """Held-out error % of any same-structure stacked params
+        (shadow or incumbent) — fixed micro_batch-shaped chunks, one
+        compile, donated [wrong, count] carry.  ``abort`` (checked
+        between chunks) lets the scavenger bail out the moment live
+        traffic arrives: returns None and the gate round defers —
+        a multi-chunk sweep must never sit under a request."""
+        chunk = self.micro_batch
+        acc = self.device.zeros(2, np.float32)
+        for i in range(0, len(x), chunk):
+            if abort is not None and abort():
+                return None
+            xb, lb, mask = batching.pad_chunk(
+                np.asarray(x[i:i + chunk], np.float32),
+                np.asarray(labels[i:i + chunk], np.int32), chunk)
+            acc = self._score(params, acc, xb, lb, mask)
+            if abort is not None:
+                # sync EVERY chunk: async dispatches otherwise pile
+                # onto the device queue, and a serving dispatch
+                # submitted behind the pile waits for all of it — the
+                # abort check is only as fine-grained as the longest
+                # un-synced chain (measured: the whole 1.2x-bar gap)
+                acc.block_until_ready()
+        acc = np.asarray(acc)
+        return 100.0 * float(acc[0]) / max(float(acc[1]), 1.0)
+
+    def reset_from(self, stacked_params: Any) -> None:
+        """Rollback: the shadow restarts from a device copy of the
+        given (incumbent) tree, momentum cleared."""
+        import jax
+        import jax.numpy as jnp
+        self._params = jax.tree_util.tree_map(jnp.copy,
+                                              stacked_params)
+        self._opt = self._fresh_opt()
+
+    def take_params(self) -> Any:
+        """The promotion handoff: returns the current shadow tree and
+        re-copies it as the new working tree, so the engine owns one
+        pytree and later donated steps can never invalidate it."""
+        import jax
+        import jax.numpy as jnp
+        promoted = self._params
+        self._params = jax.tree_util.tree_map(jnp.copy, promoted)
+        return promoted
+
+    def host_members(self) -> List[Dict[str, Dict[str, np.ndarray]]]:
+        """The current shadow params as N host member pytrees (the
+        spill/restore copies the residency manager keeps) — one
+        device fetch per leaf, called OFF the promotion critical
+        path."""
+        out: List[Dict[str, Dict[str, np.ndarray]]] = []
+        for i in range(self.n_members):
+            out.append({
+                fn: {pn: np.asarray(arr[i])
+                     for pn, arr in d.items()}
+                for fn, d in self._params.items()})
+        return out
+
+
+class OnlineLearner(Logger):
+    """The hive's learning tier: tap + buffers + scavenger thread +
+    per-model promotion gates."""
+
+    def __init__(self, residency: Any,
+                 environ: Optional[Dict[str, str]] = None) -> None:
+        env = environ
+        self.residency = residency
+        self.tap = TrafficTap(knobs.get(knobs.ONLINE_TAP_FRAC, env))
+        self.buffer_rows = int(knobs.get(knobs.ONLINE_BUFFER_ROWS,
+                                         env))
+        self.holdout_every = int(knobs.get(knobs.ONLINE_HOLDOUT_EVERY,
+                                           env))
+        self.micro_batch = int(knobs.get(knobs.ONLINE_MICRO_BATCH,
+                                         env))
+        self.min_steps = int(knobs.get(knobs.ONLINE_MIN_STEPS, env))
+        self.margin = float(knobs.get(knobs.ONLINE_PROMOTE_MARGIN,
+                                      env))
+        self.idle_s = max(0.0, float(knobs.get(knobs.ONLINE_IDLE_MS,
+                                               env))) / 1000.0
+        self.slo_p99_ms = float(knobs.get(knobs.ONLINE_SLO_P99_MS,
+                                          env))
+        self.lr_scale = float(knobs.get(knobs.ONLINE_LR_SCALE, env))
+        self.duty = min(1.0, max(0.01, float(
+            knobs.get(knobs.ONLINE_DUTY, env))))
+        #: monotonic ts before which the duty throttle vetoes the
+        #: next step (rest = cost * (1-duty)/duty after each one)
+        self._rest_until = 0.0
+        self._lock = witness.lock("online.learner")
+        self._trainers: Dict[str, ShadowTrainer] = {}
+        self._gates: Dict[str, PromotionGate] = {}
+        #: EMA of the fetched step wall (ms) — the SLO headroom input
+        self._step_ema_ms: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- arming --------------------------------------------------------
+
+    def arm_model(self, name: str) -> bool:
+        """Arm learning for one hosted model.  Needs the packaged
+        workflow's gradient chain (meta["workflow"]) and a resident
+        engine; returns False (and stays silent on the serving path)
+        otherwise."""
+        m = self.residency.models[name]
+        w = m.meta.get("workflow")
+        gds = list(getattr(w, "gds", []) or [])
+        evaluator = getattr(w, "evaluator", None)
+        if w is None or evaluator is None or \
+                not any(gd is not None for gd in gds):
+            self.warning("online: model %r has no trainable chain; "
+                         "not armed", name)
+            return False
+        engine = self.residency.ensure(name)
+        # the package seed when the hive recorded one, else a stable
+        # name digest — NEVER hash() (salted per process: it would
+        # break the offline oracle's replay of the sample stream)
+        import zlib
+        seed = int(m.meta.get("seed")
+                   or (zlib.crc32(name.encode()) & 0x7FFFFFFF))
+        buf = ReplayBuffer(self.buffer_rows, seed=seed,
+                           holdout_every=self.holdout_every,
+                           dequant=getattr(w.loader, "dequant", None))
+        trainer = ShadowTrainer(
+            m.forwards, gds, evaluator, self.residency.device,
+            engine.stacked_params, seed=seed,
+            lr_scale=self.lr_scale, micro_batch=self.micro_batch)
+        gate = PromotionGate(name, self.residency, self.margin,
+                             self.min_steps)
+        with self._lock:
+            self._trainers[name] = trainer
+            self._gates[name] = gate
+        self.tap.arm(name, buf)
+        # the shadow's stacked params + the buffer's host bytes are
+        # real residency cost: charge them so the LRU budget sees them
+        self.residency.reserve(f"{name}@shadow", m.param_bytes)
+        telemetry.event(events.EV_ONLINE_ARMED, model=name,
+                        members=trainer.n_members,
+                        micro_batch=self.micro_batch,
+                        buffer_rows=self.buffer_rows)
+        self.info("online: armed %r (%d members, micro_batch=%d, "
+                  "buffer=%d rows)", name, trainer.n_members,
+                  self.micro_batch, self.buffer_rows)
+        return True
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="online-scavenger")
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    # -- the scavenger loop -------------------------------------------
+
+    def _serving_idle(self) -> bool:
+        """True when every resident engine's batcher is empty, quiet
+        for idle_s, and nothing is in flight (plain int reads — no
+        lock edge into the batcher from the scavenger)."""
+        now = time.monotonic()
+        for m in self.residency.models.values():
+            b = getattr(m.engine, "_batcher", None) \
+                if m.engine is not None else None
+            if b is None:
+                continue
+            if b.pending_rows > 0 or \
+                    now - b.last_activity < self.idle_s:
+                return False
+        return True
+
+    def _rest_for(self, cost_s: float) -> float:
+        """Duty-cycle rest after ``cost_s`` of scavenged work, capped
+        at 1s (the compile firing must not park the learner)."""
+        return min(1.0, cost_s * (1.0 - self.duty) / self.duty)
+
+    def _headroom(self) -> bool:
+        """The SLO check: a step whose EMA cost exceeds the target
+        p99 would BECOME the p99 of a request landing under it."""
+        if self.slo_p99_ms <= 0 or self._step_ema_ms is None:
+            return True
+        return self._step_ema_ms <= self.slo_p99_ms
+
+    def _loop(self) -> None:
+        poll = max(0.001, self.idle_s / 2.0 if self.idle_s else 0.001)
+        while not self._stop.wait(poll):
+            with self._lock:
+                items = list(self._trainers.items())
+            for name, trainer in items:
+                if self._stop.is_set():
+                    return
+                buf = self.tap.buffers.get(name)
+                gate = self._gates.get(name)
+                if buf is None or gate is None:
+                    continue
+                if buf.train_rows < self.micro_batch:
+                    gate.state = "filling"
+                    continue
+                if time.monotonic() < self._rest_until:
+                    continue   # duty throttle: resting, not busy
+                if not self._serving_idle() or not self._headroom():
+                    telemetry.counter(
+                        events.CTR_ONLINE_STEPS_SKIPPED_BUSY).inc()
+                    continue
+                try:
+                    self._step_one(name, trainer, buf, gate)
+                except Exception as e:  # noqa: BLE001 — the learner
+                    # must never take down the process it scavenges
+                    self.error("online: step failed for %r "
+                               "(disarming it): %s: %s", name,
+                               type(e).__name__, e)
+                    with self._lock:
+                        self._trainers.pop(name, None)
+
+    def _step_one(self, name: str, trainer: ShadowTrainer,
+                  buf: ReplayBuffer, gate: PromotionGate) -> None:
+        if gate.state == "filling":
+            gate.state = "training"
+        rng = trainer.sample_rng()
+        t0 = time.perf_counter()
+        x, labels = buf.sample(trainer.micro_batch, rng)
+        trainer.step(x, labels, buf.version)
+        dt = time.perf_counter() - t0
+        gate.last_step_ts = time.monotonic()
+        # the duty throttle rests the WHOLE step cost (host sample +
+        # decode + dispatch): on a shared-core box the GIL the decode
+        # loop holds is serving capacity too.  Rest is capped at 1s
+        # so the one-time compile firing cannot park the learner.
+        self._rest_until = gate.last_step_ts + self._rest_for(dt)
+        ms = 1000.0 * dt
+        self._step_ema_ms = ms if self._step_ema_ms is None else \
+            0.8 * self._step_ema_ms + 0.2 * ms
+        telemetry.counter(events.CTR_ONLINE_STEPS).inc()
+        telemetry.counter(events.CTR_ONLINE_STEP_ROWS).inc(
+            trainer.micro_batch)
+        telemetry.counter(events.CTR_ONLINE_STEP_SECONDS).inc(dt)
+        telemetry.histogram(
+            events.HIST_ONLINE_STEP_DISPATCH_SECONDS).record(dt)
+        self._publish_gauges(name, trainer, buf, gate)
+        if gate.due(trainer.steps):
+            self._gate_round(name, trainer, buf, gate)
+
+    def _gate_round(self, name: str, trainer: ShadowTrainer,
+                    buf: ReplayBuffer, gate: PromotionGate) -> None:
+        # a gate round is several step-lengths of chip + host time —
+        # the worst thing the scavenger can put under a request.  If
+        # traffic arrived during the step that made this round due,
+        # defer it (last_gate_step stays put, so it re-arms after the
+        # very next idle step).
+        if not self._serving_idle():
+            telemetry.counter(
+                events.CTR_ONLINE_STEPS_SKIPPED_BUSY).inc()
+            return
+        # ...and bound the scored slice to the NEWEST rows: the cap
+        # keeps the round's cost fixed no matter how full the holdout
+        # partition is (the 1.2x p99 acceptance bar)
+        hx, hl = buf.holdout(limit=8 * self.micro_batch)
+        if len(hx) < max(4, self.micro_batch // 8):
+            gate.last_gate_step = trainer.steps   # wait a full round
+            return
+        t0 = time.perf_counter()
+        m = self.residency.models[name]
+        engine = m.engine
+        if engine is None or not m.resident:
+            # spilled under LRU pressure: nothing to score against
+            # (or swap into) — the round re-arms after the restore
+            return
+
+        def _busy() -> bool:
+            return not self._serving_idle()
+
+        shadow_err = trainer.error_pct(trainer._params, hx, hl,
+                                       abort=_busy)
+        incumbent_err = None if shadow_err is None else \
+            trainer.error_pct(engine.stacked_params, hx, hl,
+                              abort=_busy)
+        if shadow_err is None or incumbent_err is None:
+            # traffic arrived mid-sweep: the round defers (and
+            # re-arms after the very next idle step)
+            telemetry.counter(
+                events.CTR_ONLINE_STEPS_SKIPPED_BUSY).inc()
+            return
+        gate_dt = time.perf_counter() - t0
+        telemetry.histogram(events.HIST_ONLINE_GATE_SECONDS).record(
+            gate_dt)
+        # the round's cost counts against the duty budget too
+        verdict = gate.decide(trainer.steps, shadow_err,
+                              incumbent_err)
+        if verdict == "promote":
+            gate.promote(trainer.take_params(), trainer.steps)
+            # host spill/restore copies refresh OFF the swap path
+            self.residency.refresh_host_params(
+                name, trainer.host_members())
+        elif verdict == "rollback":
+            trainer.reset_from(engine.stacked_params)
+            gate.rollback(trainer.steps)
+        # the whole round — scoring, tree copies, the host param
+        # refresh — is scavenged work; it all pays duty rest
+        self._rest_until = time.monotonic() + \
+            self._rest_for(time.perf_counter() - t0)
+        self._publish_gauges(name, trainer, buf, gate)
+
+    def _publish_gauges(self, name: str, trainer: ShadowTrainer,
+                        buf: ReplayBuffer,
+                        gate: PromotionGate) -> None:
+        nbytes = buf.nbytes
+        self.residency.reserve(f"{name}@buffer", nbytes)
+        telemetry.gauge(events.GAUGE_ONLINE_BUFFER_ROWS).set(
+            buf.train_rows + buf.holdout_rows)
+        telemetry.gauge(events.GAUGE_ONLINE_BUFFER_BYTES).set(nbytes)
+        telemetry.gauge(
+            f"online.model.{name}.buffer_rows").set(buf.train_rows)
+        telemetry.gauge(
+            f"online.model.{name}.steps").set(trainer.steps)
+        telemetry.gauge(
+            f"online.model.{name}.gate_state").set(gate.state_code())
+
+    # -- introspection (op=learn) --------------------------------------
+
+    def status(self) -> Dict[str, Dict[str, Any]]:
+        """{model: learner row} with protocol-declared keys — the
+        hive's op=learn payload and the obs/web panel feed."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            items = list(self._trainers.items())
+            gates = dict(self._gates)
+        for name, trainer in items:
+            buf = self.tap.buffers.get(name)
+            gate = gates.get(name)
+            if buf is None or gate is None:
+                continue
+            row = {
+                "state": gate.state,
+                "steps": trainer.steps,
+                "buffer_rows": buf.train_rows,
+                "holdout_rows": buf.holdout_rows,
+                "buffer_bytes": buf.nbytes,
+                "promotions": gate.promotions,
+                "rollbacks": gate.rollbacks,
+                "shadow_error_pct": gate.shadow_error_pct,
+                "incumbent_error_pct": gate.incumbent_error_pct,
+                "margin": gate.margin,
+                "time_to_serve_ms": gate.time_to_serve_ms,
+            }
+            out[name] = row
+        return out

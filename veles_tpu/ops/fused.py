@@ -899,6 +899,9 @@ class EnsembleEvalEngine:
         #: steady-state latency histogram (the PR-7 convention)
         self._batcher = None
         self._served_shapes: set = set()
+        #: one-shot post-promotion hook: called (and cleared) by the
+        #: next _serve_dispatch — the online.time_to_serve probe
+        self._on_next_dispatch = None
         self._build()
 
     def _resolved_dtype(self):
@@ -1128,6 +1131,12 @@ class EnsembleEvalEngine:
                     events.HIST_SERVE_DISPATCH_SECONDS).record(dt)
             telemetry.counter(events.CTR_SERVE_MEMBER_ROWS).inc(
                 int(xb.shape[0]) * self.n_members)
+        cb, self._on_next_dispatch = self._on_next_dispatch, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — a probe must never
+                pass           # fail the dispatch it observed
         return out
 
     def spill_params(self) -> None:
@@ -1136,6 +1145,39 @@ class EnsembleEvalEngine:
         re-uploads without retracing, so a restored model's first
         request pays one H2D transfer, not a recompile."""
         self._params = None
+
+    @property
+    def stacked_params(self):
+        """The live stacked param pytree (None while spilled) — the
+        online promotion gate scores the incumbent through this and
+        the shadow trainer seeds its working copy from it."""
+        return self._params
+
+    def adopt_stacked_params(self, stacked) -> None:
+        """The HBM-to-HBM promotion handoff: replace the served params
+        with an already-device-resident pytree of the same structure.
+        ONE attribute store — a dispatch that already read the old
+        tree finishes on it, every later dispatch reads the new one;
+        no request ever sees torn params, and the compiled dispatchers
+        (keyed on shapes, which are identical) never retrace.  Callers
+        go through ResidencyManager.swap_params, which serializes this
+        against spill decisions under the residency lock."""
+        self._params = stacked
+
+    def notify_next_dispatch(self, callback) -> None:
+        """Arm a one-shot hook fired right after the next serving
+        dispatch completes (the last-step-to-first-served-request
+        clock of ``online.time_to_serve``)."""
+        self._on_next_dispatch = callback
+
+    @property
+    def busy(self) -> bool:
+        """Rows queued or in flight on the serving facade (plain int
+        reads — safe from any thread).  The residency manager's spill
+        victim selection skips busy engines: a spill mid-dispatch
+        would pull the params out from under the flush thread."""
+        b = self._batcher
+        return b is not None and b.pending_rows > 0
 
     def restore_params(self, member_params: List[Dict[str, Dict[
             str, Any]]]) -> None:

@@ -97,6 +97,10 @@ class MicroBatcher:
             else None
         self._queued_rows = 0
         self._inflight = 0          # requests taken but not resolved
+        #: monotonic ts of the last submit/resolve — with an empty
+        #: queue, "now - last_activity" is the serving idle gap the
+        #: online scavenger (veles_tpu/online) steals train steps from
+        self.last_activity = time.monotonic()
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
@@ -135,10 +139,18 @@ class MicroBatcher:
                     f"{self.label!r} serves {self._sample_shape}")
             self._queue.append(p)
             self._queued_rows += len(rows)
+            self.last_activity = time.monotonic()
             telemetry.gauge(events.GAUGE_SERVE_QUEUE_DEPTH).set(
                 self._queued_rows)
             self._cond.notify_all()
         return p.future
+
+    @property
+    def pending_rows(self) -> int:
+        """Queued rows + in-flight requests, as plain int reads (no
+        lock): the scavenger's busy check must never take the batcher
+        lock from another thread just to peek."""
+        return self._queued_rows + self._inflight
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Block until the queue is empty and every taken request has
@@ -294,6 +306,7 @@ class MicroBatcher:
                                         else p.results[0])
                 done.append(p)
         now = time.perf_counter()
+        self.last_activity = time.monotonic()
         with self._cond:
             for p in done:
                 telemetry.histogram(

@@ -60,10 +60,13 @@ class HiveClient:
                  install_dir: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None,
                  cwd: Optional[str] = None,
+                 online: bool = False,
                  start_timeout: float = 120.0) -> None:
         cmd = [sys.executable, "-m", "veles_tpu", "--serve-models"]
         cmd += [f"{name}={path}" for name, path in models.items()]
         cmd += ["-b", backend]
+        if online:
+            cmd += ["--online"]
         if max_batch is not None:
             cmd += ["--max-batch", str(max_batch)]
         if max_wait_ms is not None:
@@ -228,19 +231,38 @@ class HiveClient:
     # -- API -----------------------------------------------------------
 
     def submit(self, model: str, rows: Any,
-               deadline_ms: Optional[float] = None) -> int:
+               deadline_ms: Optional[float] = None,
+               label: Optional[Any] = None) -> int:
         """Fire one request without waiting; returns its wire id
         (collect with :meth:`wait_for` or :meth:`collect_async`).
         ``deadline_ms`` (absolute unix-epoch milliseconds) rides the
         wire: the hive batcher drops the request unanswered once it
-        expires instead of computing for an absent waiter."""
+        expires instead of computing for an absent waiter.
+        ``label`` (per-row ground truth) feeds an ``--online`` hive's
+        learning tap."""
         jid = self._draw_id()
         msg = {"id": jid, "model": model,
                "rows": np.asarray(rows, np.float32).tolist()}
         if deadline_ms is not None:
             msg["deadline_ms"] = float(deadline_ms)
+        if label is not None:
+            msg["label"] = np.asarray(label).tolist()
         self._send(msg)
         return jid
+
+    def send_label(self, jid: int, label: Any) -> None:
+        """Deliver late ground truth for an earlier request by wire
+        id (fire-and-forget; an ``--online`` hive joins it into the
+        replay buffer, anything else ignores it)."""
+        self._send({"label_of": jid,
+                    "label": np.asarray(label).tolist()})
+
+    def learn(self, timeout: float = 60.0) -> Dict[str, Any]:
+        """The online learner's per-model introspection rows
+        (op=learn): {} when the hive is not learning."""
+        jid = self._draw_id()
+        self._send({"op": "learn", "id": jid})
+        return self._wait(jid, timeout)["learn"]
 
     def cancel(self, jid: int) -> bool:
         """Abandon interest in request ``jid`` — the timeout-cleanup /

@@ -44,6 +44,13 @@ answer nobody is waiting for), ``{"op": "stats", "id"}``,
 echo: the row count and the crc32 of the float32 probability payload,
 recomputed by the fleet router — a mismatch is an integrity strike
 against this replica (Sentinel, veles_tpu/serve/sentinel.py).
+
+With ``--online`` (Evergreen, veles_tpu/online): a request may carry
+``"label"`` (per-row ground truth) to feed the learning tap, truth
+known only later joins by wire id via ``{"label_of": <id>,
+"label": [...]}`` (no response line), and ``{"op": "learn", "id"}``
+answers ``{"id", "learn": {model: {state, steps, buffer_rows,
+...}}}`` — the learner's per-model introspection row.
 """
 
 from __future__ import annotations
@@ -138,7 +145,10 @@ def load_model_package(name: str, pkg_path: str, device,
     return HostedModel(
         name, w.forwards, [m["params"] for m in members],
         meta={"workflow": w, "version": manifest.get("version"),
-              "package": os.path.basename(pkg_path)},
+              "package": os.path.basename(pkg_path),
+              # the package seed keys the online tier's deterministic
+              # sample stream (the offline-oracle replay contract)
+              "seed": int(members[0].get("seed", 1234))},
         sample_shape=sample_shape)
 
 
@@ -167,6 +177,14 @@ def build_parser() -> argparse.ArgumentParser:
                    default=float(knobs.get(knobs.HEARTBEAT_EVERY)),
                    help="seconds between heartbeat lines "
                         "($VELES_HEARTBEAT_EVERY; 0 disables)")
+    p.add_argument("--online", action="store_true",
+                   default=bool(knobs.get(knobs.ONLINE)),
+                   help="arm the Evergreen online-learning tier: tap "
+                        "labeled traffic, fine-tune in serving idle "
+                        "gaps, promote HBM-to-HBM through the gate "
+                        "(also $VELES_ONLINE; knobs: "
+                        "$VELES_ONLINE_TAP_FRAC, "
+                        "$VELES_ONLINE_PROMOTE_MARGIN, ...)")
     p.add_argument("--install-dir", default=None,
                    help="package install/staging directory (default: "
                         "a temp dir)")
@@ -232,11 +250,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         with emit_lock:
             print(json.dumps(obj), flush=True)
 
+    learner = None
+    if args.online:
+        from veles_tpu.online import OnlineLearner
+        learner = OnlineLearner(residency)
+        armed = [name for name, _ in specs
+                 if learner.arm_model(name)]
+        if armed:
+            learner.start()
+        else:
+            learner = None
+
     hello = {
         "ready": True, "pid": os.getpid(),
         "backend": device.backend_name, "platform": platform,
         "max_batch": residency.max_batch,
         "max_wait_ms": residency.max_wait_s * 1000.0,
+        "online": learner is not None,
         "models": {
             m.name: {"members": len(m.member_params),
                      "param_bytes": m.param_bytes,
@@ -308,6 +338,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         if op == "stats":
             emit({"id": job.get("id"), "stats": telemetry.snapshot()})
             return True
+        if op == "learn":
+            emit({"id": job.get("id"),
+                  "learn": learner.status() if learner else {}})
+            return True
+        if "label_of" in job:
+            # late ground truth joining an earlier tapped request by
+            # wire id — fire-and-forget (an orphan only counts)
+            if learner is not None:
+                learner.tap.label_for(job["label_of"],
+                                      job.get("label"))
+            return True
         jid = job.get("id")
         telemetry.counter(events.CTR_SERVE_REQUESTS).inc()
         if faults.fire("hive.wedge", model=job.get("model")):
@@ -327,6 +368,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             telemetry.counter(events.CTR_SERVE_REQUEST_ERRORS).inc()
             emit({"id": jid, "error": f"{type(e).__name__}: {e}"})
             return True
+        if learner is not None:
+            # tapped AFTER admission: only rows the engine accepted
+            # (shape-checked, submitted) may enter the replay buffer
+            learner.tap.tap(model, jid, rows, job.get("label"))
 
         def _deliver(f, jid=jid, model=model) -> None:
             try:
@@ -383,6 +428,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             continue
         n_late += 1
         handle(line)
+    if learner is not None:
+        # the scavenger stops BEFORE the drain: a fine-tune step must
+        # not race the batchers' final dispatches for the chip
+        learner.stop()
     drained = residency.drain_all()
     telemetry.event(events.EV_SERVE_DRAIN, late_requests=n_late,
                     complete=bool(drained))

@@ -42,6 +42,11 @@ K_MODEL = _k("model")            #: registered model name
 K_ROWS = _k("rows")              #: f32 sample rows, nested lists
 K_DEADLINE_MS = _k("deadline_ms")  #: absolute unix-epoch deadline
 
+K_LABEL = _k("label")            #: optional ground-truth (per row or
+#: one scalar for the whole request) — feeds the online-learning tap
+K_LABEL_OF = _k("label_of")      #: late label join: the wire id of
+#: the earlier request these labels belong to
+
 # -- responses ---------------------------------------------------------
 
 K_PRED = _k("pred")              #: argmax per row
@@ -76,6 +81,8 @@ K_FRACTION = _k("fraction")      #: canary: mirrored traffic fraction
 K_SLO_P99_MS = _k("slo_p99_ms")
 K_MAX_INFLIGHT = _k("max_inflight")
 
+K_ONLINE = _k("online")          #: hello: the learning tier is armed
+
 # -- heartbeats --------------------------------------------------------
 
 K_HB = _k("hb")                  #: heartbeat sequence number
@@ -104,6 +111,21 @@ K_PROBE_FAILS = _k("probe_fails")
 K_EJECTIONS = _k("ejections")
 K_REINSTATEMENTS = _k("reinstatements")
 K_LATENCY_EMA_MS = _k("latency_ema_ms")
+# the learner's per-model introspection row (hive op=learn) — buffer
+# fill, scavenged steps, and the promotion gate's live standing
+K_LEARN = _k("learn")            #: op=learn: {model: learner row}
+K_BUFFER_ROWS = _k("buffer_rows")
+K_HOLDOUT_ROWS = _k("holdout_rows")
+K_BUFFER_BYTES = _k("buffer_bytes")
+K_TAPPED_ROWS = _k("tapped_rows")
+K_LABELED_ROWS = _k("labeled_rows")
+K_STEPS = _k("steps")
+K_PROMOTIONS = _k("promotions")
+K_ROLLBACKS = _k("rollbacks")
+K_SHADOW_ERROR_PCT = _k("shadow_error_pct")
+K_INCUMBENT_ERROR_PCT = _k("incumbent_error_pct")
+K_MARGIN = _k("margin")          #: promote margin the gate holds
+K_TIME_TO_SERVE_MS = _k("time_to_serve_ms")
 
 
 def known(key: str) -> bool:

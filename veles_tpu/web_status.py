@@ -103,7 +103,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_metrics_json(self) -> None:
         from veles_tpu.obs import (fleet_model_rows, fleet_rows,
-                                   load_dir)
+                                   learner_rows, load_dir)
         reg, snaps, journals, events = load_dir(self.metrics_dir)
         merged = reg.snapshot()
         merged["snapshots"] = len(snaps)
@@ -113,6 +113,9 @@ class _Handler(BaseHTTPRequestHandler):
             merged["fleet"] = {
                 "replicas": replicas,
                 "models": fleet_model_rows(reg, events)}
+        learners = learner_rows(reg, events)
+        if learners:
+            merged["learner"] = learners
         self._send(200, json.dumps(merged).encode(),
                    "application/json")
 
