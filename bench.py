@@ -1948,6 +1948,241 @@ def streaming_metric(device, phase):
         return None
 
 
+#: the mesh phase's forced virtual device count (the same
+#: forced-host-device-count recipe tests/conftest.py and the dryrun
+#: document) and its workload shape — FC-net scale: the phase measures
+#: CAPACITY placement, not conv throughput
+MESH_DEVICES = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
+MESH_ROWS_TRAIN, MESH_ROWS_VALID = 4096, 1025   # 5121: ragged tail
+MESH_SAMPLE = (16, 16, 1)
+
+
+def mesh_metric_record(phase):
+    """The Lattice acceptance instrument (ISSUE 15), in-process on a
+    forced MESH_DEVICES-device XLA:CPU mesh.  A one-core box cannot
+    show compute scaling — every virtual device timeshares the same
+    silicon — so this phase measures what DOES transfer to a real
+    v5e-8: CAPACITY.  Per-device resident bytes sharded vs replicated
+    (against scripts/scaling_model.py's analytic prediction), the
+    over-one-device-budget dataset going resident instead of
+    streaming, bitwise sharded-vs-unsharded trajectory parity, zero
+    post-warmup recompiles, and the member-sharded cohort cap x N
+    with f32-exact GA fitness parity."""
+    import jax
+
+    from scripts.scaling_model import sharded_residency_prediction
+    from veles_tpu import prng
+    from veles_tpu.backends import JaxDevice
+    from veles_tpu.datasets import synthetic_classification
+    from veles_tpu.genetics.worker import _hbm_cohort_cap
+    from veles_tpu.loader import ArrayLoader
+    from veles_tpu.ops.fused import PopulationTrainEngine
+    from veles_tpu.ops.standard_workflow import StandardWorkflow
+    from veles_tpu.parallel import DataParallel, padded_rows
+
+    n_dev = MESH_DEVICES
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    assert len(jax.devices("cpu")) >= n_dev, len(jax.devices("cpu"))
+    rows = MESH_ROWS_TRAIN + MESH_ROWS_VALID
+    row_bytes = int(np.prod(MESH_SAMPLE)) * 4
+    total_bytes = rows * row_bytes
+
+    def build_mesh_wf(**loader_kw):
+        prng._streams.clear()
+        prng.seed_all(777)
+        train, valid, _ = synthetic_classification(
+            MESH_ROWS_TRAIN, MESH_ROWS_VALID, MESH_SAMPLE,
+            n_classes=10, seed=42)
+        gd = {"learning_rate": 0.1, "weight_decay": 1e-4,
+              "gradient_moment": 0.9}
+        return StandardWorkflow(
+            loader_factory=lambda w: ArrayLoader(
+                w, train=train, valid=valid, minibatch_size=64,
+                name="loader", **loader_kw),
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 64}, "<-": gd},
+                {"type": "softmax", "->": {"output_sample_shape": 10},
+                 "<-": gd},
+            ],
+            decision_config={"max_epochs": 3}, name="mesh_bench")
+
+    def run_one(**loader_kw):
+        w = build_mesh_wf(**loader_kw)
+        dp = DataParallel(w, n_dev)
+        w.initialize(device=dp.install())
+        t0 = time.perf_counter()
+        w.run()
+        wall = time.perf_counter() - t0
+        hist = [(h["class"], float(h["n_err"]), float(h["loss"]))
+                for h in w.decision.history]
+        params = {f.name: {k: np.asarray(v) for k, v in
+                           w.fused._params[f.name].items()}
+                  for f in w.forwards}
+        return w, wall, hist, params
+
+    phase(f"mesh: replicated-residency oracle ({n_dev}-device CPU "
+          f"mesh)")
+    w_rep, wall_rep, hist_rep, params_rep = run_one(mesh_shard="never")
+    dev_rep = w_rep.loader.original_data.devmem
+    per_dev_rep = max(s.data.nbytes
+                      for s in dev_rep.addressable_shards)
+    assert dev_rep.is_fully_replicated
+    w_rep.stop()
+
+    # budget: over ONE device (total/2 < total) but fits at total/N —
+    # pre-Lattice this exact configuration degraded to host streaming
+    budget = total_bytes // 2
+    phase("mesh: row-sharded residency (budget total/2 — used to "
+          "stream)")
+    w_sh, wall_sh, hist_sh, params_sh = run_one(
+        max_resident_bytes=budget)
+    sharded_resident = bool(w_sh.loader.shard_resident
+                            and not w_sh.fused.streaming)
+    dev_sh = w_sh.loader.original_data.devmem
+    per_dev_sh = max(s.data.nbytes for s in dev_sh.addressable_shards)
+    pad_rows = int(dev_sh.shape[0]) - rows
+
+    # bitwise trajectory parity: sharded residency must not change a
+    # single f32 of the history or the final params
+    parity_diff = 0.0
+    parity_exact = hist_rep == hist_sh
+    for fn in params_rep:
+        for k in params_rep[fn]:
+            d = float(np.abs(params_rep[fn][k]
+                             - params_sh[fn][k]).max())
+            parity_diff = max(parity_diff, d)
+            parity_exact = parity_exact and d == 0.0
+
+    # post-warmup recompiles: the 3-epoch run above IS the warmup;
+    # another epoch's worth of firings must add zero jit cache entries
+    phase("mesh: recompile probe (one extra epoch of firings)")
+    fused, loader = w_sh.fused, w_sh.loader
+    firings = -(-MESH_ROWS_TRAIN // 64) + -(-MESH_ROWS_VALID // 64)
+    size0 = (fused._train_step._cache_size()
+             + fused._eval_step._cache_size())
+    for _ in range(firings):
+        loader.run()
+        fused.run()
+    np.asarray(fused._acc)
+    recompiles = (fused._train_step._cache_size()
+                  + fused._eval_step._cache_size()) - size0
+    w_sh.stop()
+
+    # analytic cross-check (scripts/scaling_model.py): measured
+    # per-device shard bytes vs the ceil(R/N)*row_bytes prediction
+    pred = sharded_residency_prediction(rows, row_bytes, n_dev)
+    pred_delta_pct = round(
+        100.0 * (per_dev_sh - pred["per_device_bytes"])
+        / pred["per_device_bytes"], 4)
+
+    # -- member-sharded cohort: cap x N + f32-exact fitness parity ----
+    phase("mesh: member-sharded GA cohort (12 members, parity vs "
+          "unsharded)")
+
+    def build_cohort_wf():
+        prng._streams.clear()
+        prng.seed_all(1234)
+        train, valid, _ = synthetic_classification(
+            256, 96, (8, 8, 1), n_classes=4, seed=5)
+        gd = {"learning_rate": 0.1, "weight_decay": 1e-3,
+              "gradient_moment": 0.9}
+        w = StandardWorkflow(
+            loader_factory=lambda wf: ArrayLoader(
+                wf, train=train, valid=valid, minibatch_size=32,
+                name="loader"),
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 16}, "<-": gd},
+                {"type": "softmax", "->": {"output_sample_shape": 4},
+                 "<-": gd},
+            ],
+            decision_config={"max_epochs": 2, "fail_iterations": 1},
+            name="mesh_cohort")
+        w.initialize(device=JaxDevice(platform="cpu"))
+        return w
+
+    p_members = 12
+    lrs = [0.05 + 0.05 * i for i in range(p_members)]
+    rates = np.asarray([[[lr, lr], [lr, lr]] for lr in lrs],
+                       np.float32)
+    decays = np.asarray([[[1e-3, 0.0], [0.0, 0.0]]] * p_members,
+                        np.float32)
+
+    w_c = build_cohort_wf()
+    cap1 = _hbm_cohort_cap(w_c, 0, n_devices=1)
+    cap_n = _hbm_cohort_cap(w_c, 0, n_devices=n_dev)
+    eng = PopulationTrainEngine(w_c, rates, decays)
+    fits_un = np.asarray(eng.run())
+    eng.release()
+    w_c.stop()
+
+    w_c = build_cohort_wf()
+    from veles_tpu.parallel import make_mesh
+    eng = PopulationTrainEngine(w_c, rates, decays,
+                                mesh=make_mesh(n_dev))
+    member_sharded = bool(eng.member_sharded)
+    fits_sh = np.asarray(eng.run())
+    eng.release()
+    w_c.stop()
+    fit_diff = float(np.abs(fits_un - fits_sh).max())
+
+    return {
+        "mesh_devices": n_dev,
+        "mesh_platform": "cpu",
+        "mesh_dataset_rows": rows,
+        "mesh_dataset_bytes_total": total_bytes,
+        "mesh_per_device_bytes_replicated": int(per_dev_rep),
+        "mesh_per_device_bytes_sharded": int(per_dev_sh),
+        "mesh_residency_reduction_x": round(
+            per_dev_rep / per_dev_sh, 2),
+        "mesh_padding_rows": pad_rows,
+        "mesh_pred_per_device_bytes": pred["per_device_bytes"],
+        "mesh_pred_delta_pct": pred_delta_pct,
+        "mesh_over_budget_resident": sharded_resident,
+        "mesh_budget_bytes": budget,
+        "mesh_train_parity_exact": bool(parity_exact),
+        "mesh_train_parity_max_abs_diff": parity_diff,
+        "mesh_recompiles_post_warmup": int(recompiles),
+        "mesh_wall_replicated_sec": round(wall_rep, 2),
+        "mesh_wall_sharded_sec": round(wall_sh, 2),
+        "mesh_cohort_members": p_members,
+        "mesh_cohort_member_sharded": member_sharded,
+        "mesh_cohort_cap_1dev": int(cap1),
+        "mesh_cohort_cap_mesh": int(cap_n),
+        "mesh_cohort_cap_x": round(cap_n / max(cap1, 1), 2),
+        "mesh_cohort_fitness_max_abs_diff": fit_diff,
+    }
+
+
+def mesh_metric(phase):
+    """Full-run wrapper: the mesh phase needs a CPU backend with
+    MESH_DEVICES virtual devices, which the headline process (real
+    chip, no forced host devices) cannot provide — so it runs
+    ``bench.py --mesh-only`` in a pinned subprocess (the
+    dryrun_multichip re-exec pattern) and adopts its record."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{MESH_DEVICES}").strip()
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mesh-only"],
+            env=env, capture_output=True, text=True, timeout=900)
+        if res.returncode != 0:
+            print(f"mesh phase failed (rc={res.returncode}): "
+                  f"{res.stderr[-2000:]}", file=sys.stderr)
+            return None
+        return json.loads(res.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"mesh phase failed: {e}", file=sys.stderr)
+        return None
+
+
 def main() -> None:
     # the streaming phase re-derives its base set from the same args —
     # opt into the dataset memo (datasets._synth_cache)
@@ -1985,6 +2220,38 @@ def main() -> None:
             print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}",
                   file=sys.stderr, flush=True)
         print(json.dumps(fleet_metric(_phase)), flush=True)
+        return
+    if "--mesh-only" in sys.argv:
+        # fast path: ONLY the Lattice mesh phase — forced
+        # MESH_DEVICES-device XLA:CPU mesh (the ISSUE 15 acceptance
+        # gate: per-device resident bytes, over-budget-goes-resident,
+        # bitwise parity, recompiles, cohort cap x N).  The backend
+        # must be pinned BEFORE the first jax import; when another
+        # backend already initialized, re-exec pinned (the
+        # dryrun_multichip pattern).
+        want = f"--xla_force_host_platform_device_count={MESH_DEVICES}"
+        if "jax" in sys.modules:
+            import jax
+            ok = jax.default_backend() == "cpu" and \
+                len(jax.devices("cpu")) >= MESH_DEVICES
+            if not ok:
+                rec = mesh_metric(lambda m: print(
+                    f"[bench] {m}", file=sys.stderr, flush=True))
+                print(json.dumps(rec), flush=True)
+                return
+        else:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        t0 = time.perf_counter()
+
+        def _phase(msg):
+            print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}",
+                  file=sys.stderr, flush=True)
+        print(json.dumps(mesh_metric_record(_phase)), flush=True)
         return
     from veles_tpu import profiling
     from veles_tpu.backends import make_device
@@ -2161,6 +2428,29 @@ def main() -> None:
         "online_window_sec": None,
         "online_buffer_bytes": None,
         "online_platform": None,
+        "mesh_devices": None,
+        "mesh_platform": None,
+        "mesh_dataset_rows": None,
+        "mesh_dataset_bytes_total": None,
+        "mesh_per_device_bytes_replicated": None,
+        "mesh_per_device_bytes_sharded": None,
+        "mesh_residency_reduction_x": None,
+        "mesh_padding_rows": None,
+        "mesh_pred_per_device_bytes": None,
+        "mesh_pred_delta_pct": None,
+        "mesh_over_budget_resident": None,
+        "mesh_budget_bytes": None,
+        "mesh_train_parity_exact": None,
+        "mesh_train_parity_max_abs_diff": None,
+        "mesh_recompiles_post_warmup": None,
+        "mesh_wall_replicated_sec": None,
+        "mesh_wall_sharded_sec": None,
+        "mesh_cohort_members": None,
+        "mesh_cohort_member_sharded": None,
+        "mesh_cohort_cap_1dev": None,
+        "mesh_cohort_cap_mesh": None,
+        "mesh_cohort_cap_x": None,
+        "mesh_cohort_fitness_max_abs_diff": None,
         "conv_roofline_minibatch": None,
         "conv_roofline_layers": None,
         "conv_roofline_total_efficiency": None,
@@ -2260,6 +2550,13 @@ def main() -> None:
     ol = online_metric(phase)
     if ol:
         record.update(ol)
+    emit()
+
+    phase(f"measuring mesh sharding (Lattice, forced {MESH_DEVICES}-"
+          f"device CPU mesh subprocess)")
+    ms = mesh_metric(phase)
+    if ms:
+        record.update(ms)
     emit()
 
     phase("measuring per-conv roofline (layer_roofline --measure)")

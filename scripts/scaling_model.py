@@ -43,6 +43,28 @@ def param_bytes(forwards, dtype_bytes: int = 4) -> int:
     return total
 
 
+def sharded_residency_prediction(n_rows: int, row_bytes: int,
+                                 n_devices: int) -> dict:
+    """Per-device HBM bytes of the Lattice row-sharded resident
+    placement: rows padded to a whole per-device tile, 1/N rows per
+    device — the analytic number bench.py's --mesh-only phase checks
+    its MEASURED per-device shard bytes against (and the delta it
+    records).  A replicated placement costs ``n_rows * row_bytes`` on
+    EVERY device; sharding divides it by N at the price of at most
+    one tile row of padding per device."""
+    rows_padded = -(-int(n_rows) // int(n_devices)) * int(n_devices)
+    per_device = rows_padded // int(n_devices) * int(row_bytes)
+    return {
+        "n_rows": int(n_rows),
+        "rows_padded": int(rows_padded),
+        "n_devices": int(n_devices),
+        "per_device_bytes": int(per_device),
+        "replicated_per_device_bytes": int(n_rows) * int(row_bytes),
+        "reduction_x": round(
+            (int(n_rows) * int(row_bytes)) / max(per_device, 1), 3),
+    }
+
+
 def main() -> None:
     from veles_tpu import prng
     from veles_tpu.backends import NumpyDevice
@@ -80,6 +102,11 @@ def main() -> None:
             "scaling_x_zero_overlap": round(n * worst, 2),
             "scaling_x_full_overlap": float(n),
         })
+    # the Lattice residency axis: the bench resident config's dataset
+    # (one superstep group of mb*8 distinct 227x227x3 rows) sharded
+    # over the same 8 chips — capacity scaling next to the throughput
+    # scaling the table above models
+    row_b = 227 * 227 * 3 * 4
     print(json.dumps({
         "model": "AlexNet-1000",
         "param_bytes_f32": bytes_f32,
@@ -88,6 +115,8 @@ def main() -> None:
         "n_chips": n,
         "north_star_x": 6.0,
         "rows": rows,
+        "sharded_residency": sharded_residency_prediction(
+            mb * 8, row_b, n),
     }, indent=2))
     ok = all(r["scaling_x_zero_overlap"] >= 6.0 for r in rows)
     print(f"# north star >=6x holds even with ZERO comm/compute "

@@ -1,0 +1,362 @@
+"""Lattice (ISSUE 15): mesh-sharded residency and population
+execution — capacity scales with the mesh instead of replicating it.
+
+Pins, on the suite's 8-virtual-device CPU mesh:
+
+- the accounting fix: ``MeshJaxDevice.put`` (replicated) charges N x
+  bytes against ``h2d_bytes``; ``put_sharded`` charges the padded
+  total once (= total/N per device), and the device store really
+  holds 1/N rows per device;
+- **f32-EXACT parity** of sharded vs unsharded (replicated) residency
+  for resident fused training — the shard_map local-gather + psum
+  assembly sums each row with N-1 exact zeros, so the placement
+  cannot change a single bit of the trajectory;
+- the residency decision: a dataset over ONE device's budget goes
+  row-sharded RESIDENT on a mesh (it used to degrade to host
+  streaming), still streams when even total/N does not fit, and
+  non-divisible row counts ride the padded tile tail;
+- **f32-EXACT parity** of member-sharded vs unsharded GA cohorts
+  (members are embarrassingly parallel — P/N-per-device placement
+  must not change per-member math), including a cohort smaller than
+  the mesh (pure padding) and the ``_hbm_cohort_cap`` x N unlock;
+- the EnsembleEvalEngine row-sharded ``attach_dataset`` variant
+  scoring bit-identically to the replicated attach.
+"""
+
+import numpy as np
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import JaxDevice
+from veles_tpu.datasets import synthetic_classification
+from veles_tpu.loader import ArrayLoader
+from veles_tpu.ops.standard_workflow import StandardWorkflow
+from veles_tpu.parallel import (DataParallel, MeshJaxDevice, make_mesh,
+                                padded_rows)
+
+N_TRAIN, N_VALID = 480, 101          # 581 total — NOT divisible by 8
+SAMPLE = (12, 12, 1)
+TOTAL_BYTES = (N_TRAIN + N_VALID) * int(np.prod(SAMPLE)) * 4
+
+
+def build_workflow(mb=48, max_epochs=2, momentum=0.9, **loader_kw):
+    prng.seed_all(777)
+    train, valid, _ = synthetic_classification(
+        N_TRAIN, N_VALID, SAMPLE, n_classes=10, seed=42)
+    gd = {"learning_rate": 0.1, "weight_decay": 0.0001,
+          "gradient_moment": momentum}
+    return StandardWorkflow(
+        loader_factory=lambda w: ArrayLoader(
+            w, train=train, valid=valid, minibatch_size=mb,
+            name="loader", **loader_kw),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 32},
+             "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": max_epochs},
+        name="mesh_shard_test")
+
+
+def run_mesh(n=8, **loader_kw):
+    """One mesh training run -> (history, final host params)."""
+    w = build_workflow(**loader_kw)
+    dp = DataParallel(w, n)
+    w.initialize(device=dp.install())
+    w.run()
+    params = {f.name: {k: np.asarray(v)
+                       for k, v in w.fused._params[f.name].items()}
+              for f in w.forwards}
+    hist = list(w.decision.history)
+    shard = bool(w.loader.shard_resident)
+    stream = bool(w.fused.streaming)
+    devmem = w.loader.original_data.devmem
+    w.stop()
+    return hist, params, shard, stream, devmem
+
+
+class TestMeshAccounting:
+    def test_replicated_put_charges_n_copies(self):
+        """The PR-15 accounting fix: an 8-device replicated upload
+        physically lands 8 copies and must charge 8x (it charged 1x
+        while burning N x HBM)."""
+        dev = MeshJaxDevice(make_mesh(8))
+        base = dev.h2d_bytes
+        dev.put(np.zeros((10, 10), np.float32))
+        assert dev.h2d_bytes - base == 400 * 8
+
+    def test_sharded_put_charges_total_over_n_per_device(self):
+        dev = MeshJaxDevice(make_mesh(8))
+        base = dev.h2d_bytes
+        buf = dev.put_sharded(np.zeros((10, 7), np.float32))
+        # 10 rows pad to 16 (2 per device); charge = padded total once
+        assert buf.shape[0] == 16
+        assert dev.h2d_bytes - base == 16 * 7 * 4
+        per_dev = {s.data.nbytes for s in buf.addressable_shards}
+        assert per_dev == {2 * 7 * 4}
+        assert not buf.is_fully_replicated
+
+    def test_sharded_put_preserves_dtype(self):
+        """uint8 quantized datasets must shard at 1 byte/element."""
+        dev = MeshJaxDevice(make_mesh(8))
+        buf = dev.put_sharded(np.zeros((16, 4), np.uint8))
+        assert np.dtype(buf.dtype) == np.uint8
+        assert {s.data.nbytes for s in buf.addressable_shards} == {8}
+
+    def test_padded_rows(self):
+        assert padded_rows(581, 8) == 584
+        assert padded_rows(16, 8) == 16
+        assert padded_rows(1, 8) == 8
+
+
+class TestShardedResidencyParity:
+    def test_sharded_training_is_f32_exact_vs_replicated(self):
+        """THE Lattice pin: row-sharded residency must reproduce the
+        replicated-residency mesh trajectory BITWISE — same batch
+        sharding, same gradient psum, the gather assembles each row
+        as value + (N-1) exact zeros.  Non-divisible row count (581)
+        exercises the padded tile tail throughout."""
+        h_rep, p_rep, shard_rep, _, dev_rep = run_mesh(
+            mesh_shard="never")
+        h_sh, p_sh, shard_sh, stream_sh, dev_sh = run_mesh(
+            mesh_shard="always")
+        assert not shard_rep and shard_sh and not stream_sh
+        assert dev_rep.is_fully_replicated
+        assert not dev_sh.is_fully_replicated
+        assert len(h_rep) == len(h_sh) == 4
+        for a, b in zip(h_rep, h_sh):
+            assert a["n_err"] == b["n_err"], (a, b)
+            assert a["loss"] == b["loss"], (a, b)
+        for fn in p_rep:
+            for k in p_rep[fn]:
+                assert np.array_equal(p_rep[fn][k], p_sh[fn][k]), \
+                    (fn, k)
+
+    def test_per_device_bytes_shrink_by_n(self):
+        _, _, _, _, dev_sh = run_mesh(mesh_shard="always")
+        per_dev = max(s.data.nbytes for s in dev_sh.addressable_shards)
+        # <= total/8 + one padded row tile
+        tile = padded_rows(N_TRAIN + N_VALID, 8) // 8
+        assert per_dev == tile * int(np.prod(SAMPLE)) * 4
+        assert per_dev <= TOTAL_BYTES // 8 + \
+            int(np.prod(SAMPLE)) * 4
+
+
+class TestResidencyDecision:
+    BUDGET = TOTAL_BYTES // 2      # over ONE device, fits at /8
+
+    def test_over_one_device_budget_goes_sharded_resident(self):
+        """The capacity unlock: this dataset/budget pair DEGRADED TO
+        STREAMING before Lattice; on the mesh it now goes resident
+        row-sharded, f32-exact."""
+        w = build_workflow(max_resident_bytes=self.BUDGET)
+        dp = DataParallel(w, 8)
+        w.initialize(device=dp.install())
+        assert w.loader.shard_resident
+        assert w.loader.device_resident
+        assert not w.fused.streaming and w.fused.data_sharded
+        w.stop()
+
+    def test_same_budget_single_device_still_streams(self):
+        w = build_workflow(max_resident_bytes=self.BUDGET)
+        w.initialize(device=JaxDevice(platform="cpu"))
+        assert not w.loader.device_resident and w.fused.streaming
+        w.stop()
+
+    def test_over_budget_sharded_run_matches_unsharded_oracle(self):
+        """Acceptance: the over-one-device-budget dataset trains
+        resident on the mesh with f32-exact parity to the unsharded
+        (replicated-residency) oracle."""
+        h_rep, p_rep, _, _, _ = run_mesh(mesh_shard="never")
+        h_sh, p_sh, shard, stream, _ = run_mesh(
+            max_resident_bytes=self.BUDGET)   # auto mode decides
+        assert shard and not stream
+        for a, b in zip(h_rep, h_sh):
+            assert a["n_err"] == b["n_err"] and a["loss"] == b["loss"]
+        for fn in p_rep:
+            for k in p_rep[fn]:
+                assert np.array_equal(p_rep[fn][k], p_sh[fn][k])
+
+    def test_under_sharded_budget_still_streams(self):
+        w = build_workflow(max_resident_bytes=TOTAL_BYTES // 64)
+        dp = DataParallel(w, 8)
+        w.initialize(device=dp.install())
+        assert not w.loader.shard_resident
+        assert not w.loader.device_resident and w.fused.streaming
+        w.stop()
+
+    def test_never_mode_keeps_replication(self):
+        w = build_workflow(mesh_shard="never")
+        dp = DataParallel(w, 8)
+        w.initialize(device=dp.install())
+        assert not w.loader.shard_resident
+        assert w.loader.original_data.devmem.is_fully_replicated
+        w.stop()
+
+
+class TestMemberShardedCohort:
+    """Member-sharded PopulationTrainEngine: P/N members per device,
+    f32-exact vs the unsharded engine (the existing engine is itself
+    parity-pinned against per-genome oracles in test_ga_cohort)."""
+
+    def build(self, lr, epochs=4, fail=1):
+        from veles_tpu.models import wine
+
+        class FL:
+            workflow = None
+
+        prng._streams.clear()
+        prng.seed_all(1234)
+        layers = [
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": lr, "weight_decay": 0.001,
+                    "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": lr, "gradient_moment": 0.9}},
+        ]
+        w = wine.create_workflow(
+            FL(), layers=layers,
+            decision={"max_epochs": epochs, "fail_iterations": fail})
+        w.initialize(device=JaxDevice(platform="cpu"))
+        return w
+
+    def cohort(self, lrs):
+        rates = np.asarray([[[lr, lr], [lr, lr]] for lr in lrs],
+                           np.float32)
+        decays = np.asarray([[[0.001, 0.0], [0.0, 0.0]]] * len(lrs),
+                            np.float32)
+        return rates, decays
+
+    def run_cohort(self, lrs, mesh=None):
+        from veles_tpu.ops.fused import PopulationTrainEngine
+        w = self.build(lrs[0])
+        rates, decays = self.cohort(lrs)
+        engine = PopulationTrainEngine(w, rates, decays, mesh=mesh)
+        fits = np.asarray(engine.run())
+        sharded = engine.member_sharded
+        stacked = engine._n_stacked
+        engine.release()
+        w.stop()
+        return fits, sharded, stacked
+
+    def test_member_sharded_is_f32_exact_non_divisible(self):
+        """3 members over 8 devices: pure padding cohort — fitness
+        must match the unsharded engine bitwise."""
+        lrs = [0.3, 0.05, 0.8]
+        f_un, sh_un, _ = self.run_cohort(lrs)
+        f_sh, sh_sh, stacked = self.run_cohort(lrs, mesh=make_mesh(8))
+        assert not sh_un and sh_sh
+        assert stacked == 8                 # padded to one full tile
+        assert f_sh.shape == (3,)
+        assert np.array_equal(f_un, f_sh), (f_un, f_sh)
+
+    def test_member_sharded_wide_cohort_f32_exact(self):
+        """P > N with a remainder (11 over 8 -> 16 stacked)."""
+        lrs = [0.05 + 0.06 * i for i in range(11)]
+        f_un, _, _ = self.run_cohort(lrs)
+        f_sh, sharded, stacked = self.run_cohort(lrs, mesh=make_mesh(8))
+        assert sharded and stacked == 16
+        assert np.array_equal(f_un, f_sh), (f_un, f_sh)
+
+    def test_knob_never_disables_member_sharding(self, monkeypatch):
+        from veles_tpu.ops.fused import PopulationTrainEngine
+        monkeypatch.setenv("VELES_MESH_SHARD_MEMBERS", "never")
+        w = self.build(0.3)
+        rates, decays = self.cohort([0.3, 0.5])
+        engine = PopulationTrainEngine(w, rates, decays,
+                                       mesh=make_mesh(8))
+        assert not engine.member_sharded
+        engine.release()
+        w.stop()
+
+    def test_hbm_cohort_cap_scales_with_mesh(self, monkeypatch):
+        """Acceptance: >=4x the members admitted at the same
+        per-device budget."""
+        from veles_tpu.genetics.worker import _hbm_cohort_cap
+        monkeypatch.setenv("VELES_TPU_GA_HBM_BUDGET", str(1 << 20))
+        w = self.build(0.3)
+        cap1 = _hbm_cohort_cap(w, 0, n_devices=1)
+        cap8 = _hbm_cohort_cap(w, 0, n_devices=8)
+        assert cap8 >= 4 * cap1, (cap1, cap8)
+        monkeypatch.setenv("VELES_MESH_SHARD_MEMBERS", "never")
+        assert _hbm_cohort_cap(w, 0, n_devices=8) == cap1
+        w.stop()
+
+
+class TestEnsembleShardedAttach:
+    def test_sharded_attach_scores_exactly_like_replicated(self):
+        from veles_tpu.ops.fused import EnsembleEvalEngine
+
+        prng.seed_all(7)
+        train, valid, _ = synthetic_classification(
+            200, 77, (6, 6, 1), n_classes=5, seed=3)
+        w = StandardWorkflow(
+            loader_factory=lambda wf: ArrayLoader(
+                wf, train=train, valid=valid, minibatch_size=20,
+                name="loader"),
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 16},
+                 "<-": {"learning_rate": 0.1}},
+                {"type": "softmax", "->": {"output_sample_shape": 5},
+                 "<-": {"learning_rate": 0.1}},
+            ],
+            decision_config={"max_epochs": 1}, name="ens")
+        w.initialize(device=JaxDevice(platform="cpu"))
+        w.run()
+        params = {f.name: {k: np.asarray(v)
+                           for k, v in f.gather_params().items()}
+                  for f in w.forwards}
+        x, y = valid
+        dev = MeshJaxDevice(make_mesh(8))
+        eng = EnsembleEvalEngine(list(w.forwards), [params, params],
+                                 dev)
+        eng.attach_dataset(x, y, shard=False)
+        e_rep = eng.error_pct_resident()
+        p_rep = eng.predict_proba_resident(np.arange(10))
+        eng.attach_dataset(x, y, shard=True)
+        assert eng._dataset_sharded
+        assert not eng._dataset.is_fully_replicated
+        # 77 rows -> 80 padded, 10 per device
+        assert eng._dataset.shape[0] == 80
+        e_sh = eng.error_pct_resident()
+        p_sh = eng.predict_proba_resident(np.arange(10))
+        assert e_rep == e_sh
+        assert np.array_equal(p_rep, p_sh)
+        eng.release()
+        w.stop()
+
+    def test_oversize_split_attaches_sharded_under_auto(self,
+                                                        monkeypatch):
+        """attach_dataset's auto mode mirrors the loader decision: a
+        split over one device's budget shards instead of failing the
+        budget."""
+        from veles_tpu.ops.fused import EnsembleEvalEngine
+
+        prng.seed_all(7)
+        train, valid, _ = synthetic_classification(
+            64, 40, (6, 6, 1), n_classes=5, seed=3)
+        w = StandardWorkflow(
+            loader_factory=lambda wf: ArrayLoader(
+                wf, train=train, valid=valid, minibatch_size=16,
+                name="loader"),
+            layers=[{"type": "softmax",
+                     "->": {"output_sample_shape": 5},
+                     "<-": {"learning_rate": 0.1}}],
+            decision_config={"max_epochs": 1}, name="ens2")
+        w.initialize(device=JaxDevice(platform="cpu"))
+        w.run()
+        params = {f.name: {k: np.asarray(v)
+                           for k, v in f.gather_params().items()}
+                  for f in w.forwards}
+        x, y = valid
+        monkeypatch.setenv("VELES_MAX_RESIDENT_BYTES",
+                           str(x.nbytes // 2))
+        dev = MeshJaxDevice(make_mesh(8))
+        eng = EnsembleEvalEngine(list(w.forwards), [params], dev)
+        eng.attach_dataset(x, y)    # auto
+        assert eng._dataset_sharded
+        assert eng.error_pct_resident() >= 0.0
+        eng.release()
+        w.stop()

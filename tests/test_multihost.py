@@ -46,6 +46,112 @@ def multihost_workflow(tmp_path):
     return str(wf)
 
 
+@pytest.fixture
+def cohort_multihost_workflow(tmp_path):
+    """A member-sharded GA cohort over a 2-PROCESS mesh (Lattice):
+    each process owns one CPU device, the PopulationTrainEngine
+    shards its stacked member axis across both, and every process
+    must read back the same finite fitness vector."""
+    wf = tmp_path / "mh_cohort_wf.py"
+    wf.write_text(textwrap.dedent("""
+        def run(launcher):
+            import jax
+            import numpy as np
+            from veles_tpu import prng
+            from veles_tpu.backends import JaxDevice
+            from veles_tpu.models import wine
+            from veles_tpu.ops.fused import PopulationTrainEngine
+            from veles_tpu.parallel import make_mesh
+
+            assert jax.process_count() == 2, jax.process_count()
+
+            class FL:
+                workflow = None
+
+            prng._streams.clear()
+            prng.seed_all(1234)
+            lrs = [0.3, 0.05, 0.8]
+            layers = [
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": lrs[0],
+                        "weight_decay": 0.001,
+                        "gradient_moment": 0.9}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": lrs[0],
+                        "gradient_moment": 0.9}},
+            ]
+            w = wine.create_workflow(
+                FL(), layers=layers,
+                decision={"max_epochs": 2, "fail_iterations": 1})
+            w.initialize(device=JaxDevice(platform="cpu"))
+            # one local device per process -> the 2-device mesh spans
+            # BOTH processes; members shard P/N across them
+            mesh = make_mesh(2, devices=jax.devices())
+            rates = np.asarray(
+                [[[lr, lr], [lr, lr]] for lr in lrs], np.float32)
+            decays = np.asarray(
+                [[[0.001, 0.0], [0.0, 0.0]]] * 3, np.float32)
+            engine = PopulationTrainEngine(w, rates, decays,
+                                           mesh=mesh)
+            assert engine.member_sharded
+            assert engine._n_stacked == 4   # 3 members pad to 2x2
+            fits = np.asarray(engine.run())
+            assert fits.shape == (3,), fits.shape
+            assert np.isfinite(fits).all(), fits
+            engine.release()
+            w.stop()
+            print("COHORT_MULTIHOST_OK",
+                  " ".join(f"{v:.6f}" for v in fits), flush=True)
+    """))
+    return str(wf)
+
+
+def _run_two_process(workflow_path):
+    port = free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": "2",
+            "JAX_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "veles_tpu", "--multihost",
+             "-b", "cpu", workflow_path],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=REPO, env=env))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def test_two_process_member_sharded_cohort(cohort_multihost_workflow):
+    """The Lattice multihost pin: a member-sharded cohort trains over
+    a mesh spanning two real processes, and both read back the SAME
+    fitness vector (the replicated re-layout before the host fetch is
+    what makes a sharded accumulator globally readable)."""
+    outs = _run_two_process(cohort_multihost_workflow)
+    fits_lines = []
+    for rc, out, err in outs:
+        assert rc == 0, err[-2000:]
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("COHORT_MULTIHOST_OK")]
+        assert line, (out, err[-1000:])
+        fits_lines.append(line[0])
+    assert fits_lines[0] == fits_lines[1], fits_lines
+
+
 def test_two_process_cpu_psum(multihost_workflow):
     port = free_port()
     procs = []

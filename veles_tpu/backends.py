@@ -197,7 +197,14 @@ class JaxDevice(Device):
         super().__init__()
         import jax
         self._jax = jax
-        devices = jax.devices(platform) if platform else jax.devices()
+        # LOCAL devices: in a multihost run jax.devices() enumerates
+        # every process's chips and ordinal 0 would be process 0's —
+        # non-addressable from its peers, so their first upload died
+        # with "Cannot copy array to non-addressable device".  A
+        # single-device engine must own one of ITS OWN chips; global
+        # enumeration belongs to mesh construction (parallel/mesh.py).
+        devices = jax.local_devices(backend=platform) if platform \
+            else jax.local_devices()
         self.jax_device = devices[ordinal]
         self.platform = self.jax_device.platform
         # no-op for CPU-only processes — see the function's docstring

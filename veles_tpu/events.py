@@ -71,6 +71,7 @@ EV_SNAPSHOT_FALLBACK = _ev("snapshot.fallback")
 EV_SNAPSHOT_UNRECOVERABLE = _ev("snapshot.unrecoverable")
 
 EV_LOADER_EPOCH = _ev("loader.epoch")
+EV_LOADER_SHARD_RESIDENT = _ev("loader.shard_resident")
 EV_LOADER_CORRUPT_FILE = _ev("loader.corrupt_file")
 EV_LOADER_CORRUPT_OVER_TOLERANCE = _ev("loader.corrupt_over_tolerance")
 

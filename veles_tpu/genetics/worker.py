@@ -113,14 +113,20 @@ class _CohortTooBig(Exception):
         self.cap = max(1, cap)
 
 
-def _hbm_cohort_cap(workflow, requested: int) -> int:
+def _hbm_cohort_cap(workflow, requested: int,
+                    n_devices: int = 1) -> int:
     """Largest member count one vmapped cohort may stack, from the
     params x P accounting: per member the engine holds f32 params +
     f32 momentum + a compute-dtype cast + transient grads — ~4
     param-sized buffers.  The budget is the device's reported
     ``bytes_limit`` (TPU) or ``VELES_TPU_GA_HBM_BUDGET`` (default
     8 GiB where the backend reports none), with half held back for the
-    resident dataset + the cohort's activations."""
+    resident dataset + the cohort's activations.
+
+    ``n_devices`` > 1 is the member-sharded mesh (Lattice): each
+    device stacks only P/N members, so the admissible cohort is N x
+    one device's cap at the SAME per-device budget — unless
+    $VELES_MESH_SHARD_MEMBERS says never."""
     import os
 
     import numpy as np
@@ -142,6 +148,11 @@ def _hbm_cohort_cap(workflow, requested: int) -> int:
     if budget is None:
         budget = int(os.environ.get("VELES_TPU_GA_HBM_BUDGET",
                                     8 << 30))
+    if n_devices > 1:
+        from veles_tpu import knobs
+        from veles_tpu.parallel.mesh import shard_mode
+        if shard_mode(knobs.get(knobs.MESH_SHARD_MEMBERS)) != "never":
+            budget *= int(n_devices)
     cap = max(1, (budget // 2) // per_member)
     if requested:
         cap = min(cap, max(1, requested))
@@ -184,12 +195,20 @@ def _train_cohort_chunk(create, pristine, config_files, overrides,
         launcher.create_workflow(create)
         launcher.initialize()
         w = launcher.workflow
-        cap = _hbm_cohort_cap(w, args.cohort)
+        dp = int(getattr(args, "dp", 0) or 0)
+        cap = _hbm_cohort_cap(w, args.cohort, n_devices=dp or 1)
         if len(idxs) > cap:
             raise _CohortTooBig(cap)
         rates = np.stack([hypers[i][0] for i in idxs])
         decays = np.stack([hypers[i][1] for i in idxs])
-        engine = PopulationTrainEngine(w, rates, decays)
+        mesh = None
+        if dp > 1:
+            # member-sharded cohort (Lattice): the engine shards its
+            # stacked member axis over an N-device mesh and keeps the
+            # (small, GA-scale) dataset replicated on it
+            from veles_tpu.parallel import make_mesh
+            mesh = make_mesh(dp)
+        engine = PopulationTrainEngine(w, rates, decays, mesh=mesh)
         return [float(f) for f in engine.run()]
     finally:
         if engine is not None:
@@ -444,6 +463,12 @@ def main(argv=None) -> int:
                    help="serve mode: cap on the member count of one "
                         "population-batched training dispatch "
                         "(0 = auto, bounded by the HBM budget only)")
+    p.add_argument("--dp", type=int, default=0,
+                   help="serve mode: member-shard cohort dispatches "
+                        "over an N-device mesh (P/N members per "
+                        "device; raises the HBM cohort cap by N — "
+                        "simulate on CPU with XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N)")
     p.add_argument("--heartbeat-every", type=float,
                    default=float(os.environ.get(
                        "VELES_HEARTBEAT_EVERY", "5.0")),

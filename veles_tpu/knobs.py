@@ -304,12 +304,32 @@ PLOTS_DIR = _knob(
     "Output directory of the graphics server's rendered plot "
     "artifacts.")
 
+# -- mesh execution (Lattice) ------------------------------------------
+
+MESH_SHARD_DATA = _knob(
+    "VELES_MESH_SHARD_DATA", "auto", str,
+    "Row-shard the HBM-resident dataset over the device mesh (each "
+    "device holds 1/N of the rows): `auto` shards only when the "
+    "dataset exceeds ONE device's residency budget but fits sharded "
+    "(so a dataset N x one chip's budget goes resident instead of "
+    "degrading to host streaming), `always` shards any mesh-resident "
+    "dataset, `never`/`0` keeps the replicated placement.")
+MESH_SHARD_MEMBERS = _knob(
+    "VELES_MESH_SHARD_MEMBERS", "auto", str,
+    "Shard the stacked member axis of population-batched GA cohorts "
+    "over the mesh (P/N members per device, raising the HBM cohort "
+    "cap by the device count): `auto`/`always` shard whenever the "
+    "engine is handed a mesh, `never`/`0` keeps single-device "
+    "stacking.")
+
 # -- device / kernel tuning --------------------------------------------
 
 MAX_RESIDENT_BYTES = _knob(
     "VELES_MAX_RESIDENT_BYTES", 8 << 30, int,
-    "HBM byte budget for device-resident datasets; over budget "
-    "degrades to host streaming.")
+    "PER-DEVICE HBM byte budget for device-resident datasets; over "
+    "budget degrades to host streaming (on a mesh with "
+    "$VELES_MESH_SHARD_DATA, a dataset over one device's budget "
+    "first tries the row-sharded placement at total/N per device).")
 TPU_SCAN_UNROLL = _knob(
     "VELES_TPU_SCAN_UNROLL", 1, int,
     "Unroll factor of the fused train loop's lax.scan (>1 trades "

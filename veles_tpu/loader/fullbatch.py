@@ -34,12 +34,27 @@ class FullBatchLoader(Loader):
         #: regression targets (MSE workflows) — may stay empty
         self.original_targets = Vector(name="original_targets")
         self.on_device = kwargs.get("on_device", True)
-        #: HBM residency budget for the dataset (bytes).  Datasets over
-        #: budget switch to the streaming path: host arrays stay, the
-        #: fused step consumes prefetched superstep batches instead of
-        #: gathering from an HBM-resident copy.  Overridable per loader
-        #: or via $VELES_MAX_RESIDENT_BYTES; default 8 GiB.
+        #: PER-DEVICE HBM residency budget for the dataset (bytes).
+        #: Datasets over budget switch to the streaming path: host
+        #: arrays stay, the fused step consumes prefetched superstep
+        #: batches instead of gathering from an HBM-resident copy.
+        #: Overridable per loader or via $VELES_MAX_RESIDENT_BYTES;
+        #: default 8 GiB.  On a device mesh the budget is charged per
+        #: device: a replicated dataset costs its full size on EVERY
+        #: device, and a dataset over one device's budget tries the
+        #: row-sharded placement (1/N rows per device) before
+        #: degrading to streaming — see ``mesh_shard``.
         self.max_resident_bytes = kwargs.get("max_resident_bytes", None)
+        #: mesh residency policy override ("auto"/"always"/"never");
+        #: None reads $VELES_MESH_SHARD_DATA.  "auto" row-shards the
+        #: resident dataset only when it exceeds one device's budget
+        #: but fits at total/N per device.
+        self.mesh_shard = kwargs.get("mesh_shard", None)
+        #: True = the resident dataset is ROW-SHARDED over the device
+        #: mesh (each device holds 1/N of the rows); the fused step
+        #: then gathers minibatches via the shard_map local-gather +
+        #: psum path instead of a plain on-device take.
+        self.shard_resident = False
         #: input normalization (reference: loaders own a Normalizer,
         #: veles/normalization.py) — fitted on the TRAIN split once,
         #: state rides in snapshots so resume does not refit
@@ -66,6 +81,8 @@ class FullBatchLoader(Loader):
         # attrs introduced after a snapshot was written must default
         self.__dict__.setdefault("quantized_ingest", "auto")
         self.__dict__.setdefault("_quant_pre_scale", 1.0)
+        self.__dict__.setdefault("mesh_shard", None)
+        self.__dict__.setdefault("shard_resident", False)
 
     @property
     def has_labels(self) -> bool:
@@ -165,18 +182,79 @@ class FullBatchLoader(Loader):
         return int(os.environ.get("VELES_MAX_RESIDENT_BYTES",
                                   8 << 30))
 
+    @staticmethod
+    def _mesh_of(device):
+        """The device's mesh when it actually multiplies capacity
+        (>1 device) — the row-sharded residency precondition."""
+        mesh = getattr(device, "mesh", None)
+        if mesh is not None and getattr(device, "is_jax", False) \
+                and int(mesh.devices.size) > 1:
+            return mesh
+        return None
+
+    def _sharded_per_device_bytes(self, n_devices: int) -> int:
+        """Per-device HBM cost of the row-sharded placement: every
+        resident vector padded to a whole per-device tile, 1/N rows
+        each — what the residency budget charges instead of the full
+        replicated size."""
+        from veles_tpu.parallel.mesh import padded_rows
+        total = 0
+        for v in (self.original_data, self.original_labels,
+                  self.original_targets):
+            if v and v.mem is not None and len(v.mem):
+                rows = len(v.mem)
+                total += (padded_rows(rows, n_devices) // n_devices) \
+                    * (v.nbytes // rows)
+        return total
+
+    def _decide_residency(self, device) -> None:
+        """Charge the residency budget PER DEVICE and pick the
+        placement: replicated when the dataset fits one device's
+        budget, row-sharded on a mesh when only total/N does (the
+        Lattice capacity unlock — N x one chip's budget still goes
+        resident), streaming otherwise."""
+        if not (self.original_data
+                and self.original_data.mem is not None):
+            return
+        budget = self._resident_budget()
+        data_bytes = self.original_data.nbytes
+        over = data_bytes > budget
+        mesh = self._mesh_of(device)
+        if mesh is not None:
+            from veles_tpu import events, knobs, telemetry
+            from veles_tpu.parallel.mesh import shard_mode
+            mode = shard_mode(
+                self.mesh_shard if self.mesh_shard is not None
+                else knobs.get(knobs.MESH_SHARD_DATA))
+            if mode != "never" and (over or mode == "always"):
+                n = int(mesh.devices.size)
+                per_dev = self._sharded_per_device_bytes(n)
+                if per_dev <= budget:
+                    self.shard_resident = True
+                    telemetry.event(
+                        events.EV_LOADER_SHARD_RESIDENT,
+                        devices=n, total_bytes=int(data_bytes),
+                        per_device_bytes=int(per_dev))
+                    self.info(
+                        "dataset %.1f MiB row-sharded over %d devices "
+                        "(%.1f MiB/device vs the %.1f MiB/device "
+                        "budget a replicated copy would need)",
+                        data_bytes / 2 ** 20, n, per_dev / 2 ** 20,
+                        budget / 2 ** 20)
+                    return
+        if over:
+            self.device_resident = False
+            self.info("dataset %.1f GiB (%s) exceeds the %.1f GiB "
+                      "per-device HBM residency budget — streaming "
+                      "superstep batches from host",
+                      data_bytes / 2 ** 30,
+                      self.original_data.mem.dtype,
+                      budget / 2 ** 30)
+
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
-        if self.original_data and self.original_data.mem is not None \
-                and self.original_data.nbytes > \
-                self._resident_budget():
-            self.device_resident = False
-            self.info("dataset %.1f GiB (%s) exceeds the %.1f GiB HBM "
-                      "residency budget — streaming superstep batches "
-                      "from host",
-                      self.original_data.nbytes / 2 ** 30,
-                      self.original_data.mem.dtype,
-                      self._resident_budget() / 2 ** 30)
+        self.shard_resident = False
+        self._decide_residency(device)
         resident = self.on_device and self.device_resident
         if resident and device is not None and device.is_jax:
             try:
@@ -189,8 +267,11 @@ class FullBatchLoader(Loader):
                 for v in (self.original_data, self.original_labels,
                           self.original_targets):
                     if v:
-                        v.initialize(device)
-                        v.unmap()  # one-time HBM upload
+                        if self.shard_resident:
+                            v.upload_row_sharded(device)
+                        else:
+                            v.initialize(device)
+                            v.unmap()  # one-time HBM upload
                 return
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -209,6 +290,7 @@ class FullBatchLoader(Loader):
                     "dataset upload hit device OOM (%s) — falling "
                     "back to host streaming", e)
                 self.device_resident = False
+                self.shard_resident = False
                 for v in (self.original_data, self.original_labels,
                           self.original_targets):
                     if v:

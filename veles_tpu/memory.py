@@ -131,6 +131,19 @@ class Vector:
                 and self._mem is not None:
             self.unmap()
 
+    def upload_row_sharded(self, device) -> None:
+        """Attach + upload with the leading axis row-sharded 1/N per
+        mesh device (``MeshJaxDevice.put_sharded``: rows zero-padded
+        to a whole per-device tile).  The host copy STAYS valid —
+        ``map_read`` keeps serving the unpadded host rows and
+        snapshots carry them — while ``unmap``/``current`` hand
+        consumers the sharded (padded) device buffer.  For read-only
+        buffers (resident datasets): a later host write + ``unmap``
+        would re-upload REPLICATED through the normal path."""
+        self.device = device
+        self._devmem = device.put_sharded(self._mem)
+        self._valid = HOST | DEVICE
+
     @property
     def devmem(self) -> Any:
         return self._devmem

@@ -57,16 +57,21 @@ def make_caster(cd):
 
 def stack_member_params(forwards: List[Any],
                         member_params: List[Dict[str, Dict[str, Any]]],
-                        device: Any) -> Dict[str, Dict[str, Any]]:
+                        device: Any, put: Any = None
+                        ) -> Dict[str, Dict[str, Any]]:
     """{fwd_name: {pname: (n_members, ...)}} — every member's f32
     params stacked along a leading MEMBER axis and uploaded once.
     Shared by the vmapped engines: EnsembleEvalEngine stacks N distinct
     trained members; PopulationTrainEngine stacks P copies of one init
     (same-signature genomes share the weight-init draw by seed); the
-    Hive residency manager re-uploads a spilled model through it."""
+    Hive residency manager re-uploads a spilled model through it.
+    ``put`` overrides the placement (default ``device.put``,
+    replicated on a mesh) — the member-sharded cohort path passes a
+    member-sharded placement so each device uploads P/N members."""
+    putf = put if put is not None else device.put
     return {
         f.name: {
-            pn: device.put(np.stack(
+            pn: putf(np.stack(
                 [np.asarray(m[f.name][pn], np.float32)
                  for m in member_params]))
             for pn in member_params[0][f.name]}
@@ -114,6 +119,85 @@ def pad_rows(x: np.ndarray,
         x = np.concatenate(
             [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
     return x, mask
+
+
+def make_sharded_row_gather(mesh):
+    """Traced ``gather(indices, *stores) -> rows per store`` over
+    ROW-SHARDED resident stores (each device holds 1/N of the rows;
+    ``parallel.mesh.put_row_sharded`` placement).  One store returns
+    its gathered rows bare; several (dataset + labels/targets) return
+    a tuple, gathered with ONE shard_map.
+
+    The gather is a ``shard_map`` local gather + psum assembly: every
+    device looks the full (replicated) index vector up in its OWN
+    shard, zeroes the rows it does not own, and the psum across the
+    data axis assembles the full minibatch on every device.  Exactly
+    one device contributes each row, so the reduction sums one real
+    value with N-1 zeros — f32-EXACT by IEEE-754 (x + 0.0 == x),
+    which is what lets sharded residency pin bitwise parity against
+    the replicated-residency oracle.  Integer stores (uint8 quantized
+    datasets, int32 labels) ride the psum as int32 — narrow-int
+    collectives are not universally lowered — and cast back, which is
+    exact for any byte/label value.
+
+    Indices must reference REAL rows only (< R); the padded tile tail
+    exists purely as placement filler, and the loaders' index
+    machinery (np.resize padding + validity masks) never points at
+    it."""
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec
+
+    axis = mesh.axis_names[0]
+    n = int(mesh.devices.size)
+
+    def _assemble(local_store, loc, hit):
+        x = jnp.take(local_store, loc, axis=0)
+        x = jnp.where(
+            hit.reshape(hit.shape + (1,) * (x.ndim - hit.ndim)),
+            x, jnp.zeros((), x.dtype))
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return lax.psum(x, axis)
+        return lax.psum(x.astype(jnp.int32), axis).astype(x.dtype)
+
+    def gather(indices, *stores):
+        rows_local = stores[0].shape[0] // n   # static at trace time
+
+        def local(idx, *local_stores):
+            lo = lax.axis_index(axis) * rows_local
+            loc = jnp.clip(idx - lo, 0, rows_local - 1)
+            hit = (idx >= lo) & (idx < lo + rows_local)
+            return tuple(_assemble(s, loc, hit) for s in local_stores)
+
+        spec = PartitionSpec(axis)
+        out = shard_map(
+            local, mesh=mesh,
+            in_specs=(PartitionSpec(),) + (spec,) * len(stores),
+            out_specs=(PartitionSpec(),) * len(stores),
+            check_rep=False)(indices, *stores)
+        return out[0] if len(stores) == 1 else out
+
+    return gather
+
+
+def pad_members(arrays: List[np.ndarray],
+                multiple: int) -> Tuple[List[np.ndarray], int]:
+    """Pad each array's leading MEMBER axis to a whole multiple of
+    ``multiple`` by repeating the first member's row — the
+    member-sharded cohort convention: padded members train harmlessly
+    (identical math to member 0) and their fitness rows are sliced
+    off before anything reads them.  Returns the padded arrays and
+    the padded member count."""
+    p = len(arrays[0])
+    p_pad = -(-p // multiple) * multiple
+    if p_pad == p:
+        return list(arrays), p
+    out = []
+    for a in arrays:
+        filler = np.repeat(a[:1], p_pad - p, axis=0)
+        out.append(np.concatenate([a, filler], axis=0))
+    return out, p_pad
 
 
 def padded_index_chunk(start: int, stop: int, chunk: int

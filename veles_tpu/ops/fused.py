@@ -56,6 +56,14 @@ class FusedStepRunner(AcceleratedUnit):
         #: steps are then jitted with the minibatch sharded over the
         #: mesh's data axis and params replicated (parallel/ package)
         self.mesh = None
+        #: True = the loader's resident dataset is ROW-SHARDED over
+        #: the mesh (each device holds 1/N of the rows; set from
+        #: loader.shard_resident at initialize).  The in-trace gather
+        #: then runs as a shard_map local gather + psum assembly
+        #: (batching.make_sharded_row_gather) — f32-exact vs the
+        #: replicated placement — and the dataset/target step args are
+        #: jitted with the row-sharded in_sharding.
+        self.data_sharded = False
         self._train_step = None
         self._eval_step = None
         self._params: Optional[Dict[str, Dict[str, Any]]] = None
@@ -233,7 +241,25 @@ class FusedStepRunner(AcceleratedUnit):
                 m["confusion"] = conf
             return m
 
+        data_sharded = self.data_sharded and self.mesh is not None
+        if data_sharded:
+            # row-sharded residency: the gather crosses device shards
+            # (local gather + exact psum), and the assembled minibatch
+            # re-enters the SAME batch sharding the replicated-data
+            # path uses — identical downstream program, so residency
+            # placement cannot change the numerics
+            import jax.sharding as _shd
+            sharded_gather = batching.make_sharded_row_gather(self.mesh)
+            _mb_rows = _shd.NamedSharding(
+                self.mesh,
+                _shd.PartitionSpec(self.mesh.axis_names[0]))
+
         def gather(dataset, target_store, indices):
+            if data_sharded:
+                x, t = sharded_gather(indices, dataset, target_store)
+                x = lax.with_sharding_constraint(x, _mb_rows)
+                t = lax.with_sharding_constraint(t, _mb_rows)
+                return x, t
             x = jnp.take(dataset, indices, axis=0)
             t = jnp.take(target_store, indices, axis=0)
             return x, t
@@ -385,13 +411,20 @@ class FusedStepRunner(AcceleratedUnit):
                     in_shardings=(repl, repl, repl, batch, batch,
                                   batch, repl))
             else:
+                # the resident store enters row-sharded under Lattice
+                # (1/N rows per device), replicated otherwise — the
+                # ONLY in_sharding difference between the two modes
+                store = shd.NamedSharding(
+                    self.mesh,
+                    shd.PartitionSpec(self.mesh.axis_names[0])) \
+                    if data_sharded else repl
                 self._train_step = jax.jit(
                     train_step, donate_argnums=(0, 1, 2, 3),
-                    in_shardings=(repl, repl, repl, repl, repl, repl,
+                    in_shardings=(repl, repl, repl, repl, store, store,
                                   batch, batch, repl, repl))
                 self._eval_step = jax.jit(
                     eval_step, donate_argnums=(1, 2),
-                    in_shardings=(repl, repl, repl, repl, repl,
+                    in_shardings=(repl, repl, repl, store, store,
                                   batch, batch, repl))
         elif streaming:
             self._train_step = jax.jit(train_step_stream,
@@ -425,6 +458,11 @@ class FusedStepRunner(AcceleratedUnit):
             # else: quantized ingest — the wire is uint8 (1 byte/px,
             # half the bf16 wire) and the traced prologue dequantizes;
             # a stream_dtype cast would widen the bytes back out
+        # row-sharded residency (Lattice): resolved by the loader's
+        # per-device budget accounting — the runner only follows
+        self.data_sharded = bool(
+            self.mesh is not None and not self.streaming
+            and getattr(self.loader, "shard_resident", False))
         if self.mesh is not None:
             # sharded jit partitions poorly around custom-call kernels;
             # units with hand kernels (LRN) must take their XLA form
@@ -817,6 +855,7 @@ class FusedStepRunner(AcceleratedUnit):
         self.__dict__.pop("lr_scales", None)  # pre-rename snapshots
         self.__dict__.setdefault("lr_rates", None)
         self.__dict__.setdefault("streaming", False)
+        self.__dict__.setdefault("data_sharded", False)
         self.__dict__.setdefault("stream_transfer_seconds", 0.0)
         self.__dict__.setdefault("stream_oom_retries", 0)
         # the snapshotted byte count (0 for pre-field snapshots):
@@ -890,6 +929,14 @@ class EnsembleEvalEngine:
         self.param_bytes = batching.stacked_param_bytes(member_params)
         self._dataset = None
         self._labels = None
+        #: real (unpadded) attached rows; row-sharded attachment pads
+        #: the device store to a whole per-device tile
+        self._dataset_rows = 0
+        #: True = the attached split is row-sharded over the device's
+        #: mesh (attach_dataset(shard=...)): per-device HBM is
+        #: total/N and the resident gather runs through the shared
+        #: shard_map local-gather + psum seam
+        self._dataset_sharded = False
         self._predict = None
         self._score = None
         self._predict_resident = None
@@ -939,18 +986,40 @@ class EnsembleEvalEngine:
             wrong = jnp.sum((pred != labels).astype(jnp.float32) * mask)
             return acc + jnp.stack([wrong, jnp.sum(mask)])
 
+        self._predict = jax.jit(mean_probs)
+        self._score = jax.jit(score, donate_argnums=(1,))
+        self._mean_probs = mean_probs
+        self._score_fn = score
+        self._build_resident(sharded=False)
+
+    def _build_resident(self, sharded: bool) -> None:
+        """The resident-gather dispatchers, for either placement.
+        Replicated: a plain on-device take.  Row-sharded: the shared
+        shard_map local-gather + psum seam
+        (batching.make_sharded_row_gather) — each device holds 1/N of
+        the attached rows and the assembled minibatch is f32-exact vs
+        the replicated gather, so scoring parity is bitwise."""
+        import jax
+        import jax.numpy as jnp
+
+        mean_probs, score = self._mean_probs, self._score_fn
+        if sharded:
+            gather = batching.make_sharded_row_gather(self.device.mesh)
+        else:
+            def gather(indices, *stores):
+                out = tuple(jnp.take(s, indices, axis=0)
+                            for s in stores)
+                return out[0] if len(stores) == 1 else out
+
         def predict_resident(params, dataset, indices):
-            return mean_probs(params, jnp.take(dataset, indices,
-                                               axis=0))
+            return mean_probs(params, gather(indices, dataset))
 
         def score_resident(params, acc, dataset, label_store, indices,
                            mask):
-            x = jnp.take(dataset, indices, axis=0)
-            labels = jnp.take(label_store, indices, axis=0)
+            x, labels = gather(indices, dataset, label_store)
             return score(params, acc, x, labels, mask)
 
-        self._predict = jax.jit(mean_probs)
-        self._score = jax.jit(score, donate_argnums=(1,))
+        self._dataset_sharded = sharded
         self._predict_resident = jax.jit(predict_resident)
         self._score_resident = jax.jit(score_resident,
                                        donate_argnums=(1,))
@@ -1023,12 +1092,43 @@ class EnsembleEvalEngine:
     # -- resident path -------------------------------------------------
 
     def attach_dataset(self, x: np.ndarray,
-                       labels: Optional[np.ndarray] = None) -> None:
+                       labels: Optional[np.ndarray] = None,
+                       shard: Any = "auto") -> None:
         """Upload an evaluation split ONCE; the ``*_resident`` methods
-        gather rows from HBM by index afterwards."""
-        self._dataset = self.device.put(np.asarray(x, np.float32))
-        self._labels = None if labels is None else \
-            self.device.put(np.asarray(labels, np.int32))
+        gather rows from HBM by index afterwards.
+
+        ``shard``: on a mesh device, ``True`` row-shards the split
+        (1/N rows per device — N x the attachable split at the same
+        per-device budget), ``False`` replicates it, and ``"auto"``
+        follows ``$VELES_MESH_SHARD_DATA`` + the per-device
+        ``$VELES_MAX_RESIDENT_BYTES`` budget exactly like the training
+        loaders' streaming-vs-resident decision."""
+        x = np.asarray(x, np.float32)
+        labels = None if labels is None else np.asarray(labels,
+                                                       np.int32)
+        mesh = getattr(self.device, "mesh", None)
+        if mesh is None or int(mesh.devices.size) < 2:
+            sharded = False
+        elif shard == "auto":
+            from veles_tpu import knobs
+            from veles_tpu.parallel.mesh import shard_mode
+            mode = shard_mode(knobs.get(knobs.MESH_SHARD_DATA))
+            sharded = mode == "always" or (
+                mode == "auto"
+                and x.nbytes > knobs.get(knobs.MAX_RESIDENT_BYTES))
+        else:
+            sharded = bool(shard)
+        if sharded != self._dataset_sharded:
+            self._build_resident(sharded=sharded)
+        self._dataset_rows = len(x)
+        if sharded:
+            self._dataset = self.device.put_sharded(x)
+            self._labels = None if labels is None else \
+                self.device.put_sharded(labels)
+        else:
+            self._dataset = self.device.put(x)
+            self._labels = None if labels is None else \
+                self.device.put(labels)
 
     def predict_proba_resident(self, indices) -> np.ndarray:
         if self._dataset is None:
@@ -1048,7 +1148,9 @@ class EnsembleEvalEngine:
         if self._dataset is None or self._labels is None:
             raise RuntimeError("attach_dataset(x, labels) first")
         import time
-        total = int(self._dataset.shape[0]) if n is None else int(n)
+        # the REAL attached row count — a row-sharded store is padded
+        # to a whole per-device tile and the tail must never score
+        total = self._dataset_rows if n is None else int(n)
         chunk = max(1, min(chunk, total))
         acc = self.device.zeros(2, np.float32)
         t0 = time.perf_counter()
@@ -1242,11 +1344,26 @@ class PopulationTrainEngine:
     The workflow must be built+initialized in fused mode on a jax
     device with a device-resident loader; anything else raises
     ValueError and the caller falls back to the per-genome oracle.
+
+    **Member sharding (Lattice)**: handed a ``mesh`` (or built on a
+    workflow whose fused runner carries one), the stacked MEMBER axis
+    is sharded over the mesh's data axis — P/N members per device, so
+    the HBM cohort cap scales with the device count instead of one
+    chip's budget.  Members are embarrassingly parallel (no
+    cross-member reduction anywhere in the train body), so the
+    partitioner moves nothing between devices inside the dispatch and
+    per-member math is bit-identical to the unsharded stacking —
+    fitness parity vs the unsharded engine is f32-EXACT.  The cohort
+    is padded to a whole per-device tile by repeating member 0
+    (padded members train harmlessly; their fitness rows are sliced
+    off).  The dataset/targets are placed REPLICATED over the mesh
+    (GA-scale datasets are small — sharding capacity is the
+    row-sharded residency path's job, not this one's).
     """
 
     def __init__(self, workflow, member_rates: np.ndarray,
                  member_decays: np.ndarray,
-                 compute_dtype: Any = None) -> None:
+                 compute_dtype: Any = None, mesh: Any = None) -> None:
         fused = getattr(workflow, "fused", None)
         if fused is None or fused.loader is None or \
                 fused._train_step is None:
@@ -1282,29 +1399,115 @@ class PopulationTrainEngine:
                 f"[lr, lr_bias] / [wd, wd_bias] arrays; got "
                 f"{rates.shape} / {decays.shape}")
         self.n_members = int(rates.shape[0])
+        # -- member sharding (Lattice): resolve the mesh + knob ------
+        if mesh is None:
+            mesh = getattr(fused, "mesh", None)
+        self.mesh = mesh if (mesh is not None
+                             and int(mesh.devices.size) > 1) else None
+        if self.mesh is not None:
+            from veles_tpu import knobs
+            from veles_tpu.parallel.mesh import shard_mode
+            if shard_mode(knobs.get(knobs.MESH_SHARD_MEMBERS)) \
+                    == "never":
+                self.mesh = None
+        self.member_sharded = self.mesh is not None
+        #: per-shape cached member-sharded zeros dispatchers — a fresh
+        #: jit per accumulator reset would retrace every class end
+        self._zeros_cache: Dict[Tuple[int, ...], Any] = {}
+        if self.member_sharded:
+            n_dev = int(self.mesh.devices.size)
+            (rates, decays), self._n_stacked = batching.pad_members(
+                [rates, decays], n_dev)
+        else:
+            self._n_stacked = self.n_members
         self._rates = rates
-        self._wd = device.put(decays)
+        self._wd = self._put_members(decays)
         # P copies of the single init pytree (Vectors hold the host
         # master copy after initialize) stacked on the member axis
+        # (padded members are more copies of the same init)
         host = {f.name: {pn: np.asarray(v.map_read(), np.float32)
                          for pn, v in f.param_vectors().items()}
                 for f in self.forwards}
         self._params = batching.stack_member_params(
-            self.forwards, [host] * self.n_members, device)
+            self.forwards, [host] * self._n_stacked, device,
+            put=self._put_members)
         self._opt = {}
         for gd in self.gds:
             if gd is None or not gd.accumulated_grads:
                 continue
             self._opt[gd.name] = {
-                k: device.zeros((self.n_members,) + tuple(v.shape),
-                                np.float32)
+                k: self._zeros_members((self._n_stacked,)
+                                       + tuple(v.shape))
                 for k, v in gd.accumulated_grads.items()}
-        self._acc = np.zeros((self.n_members, 3), np.float32)
+        self._acc = self._fresh_cohort_acc()
+        self._replicate = None
         self._rng_counter = 0
         self._la_iteration = 0
         self._train_step = None
         self._eval_step = None
         self._build()
+
+    # -- member-axis placement (Lattice) ------------------------------
+
+    def _put_members(self, array: np.ndarray):
+        """Upload a member-axis-leading array: sharded P/N per device
+        on a mesh, a plain device put otherwise."""
+        if not self.member_sharded:
+            return self.device.put(array)
+        from veles_tpu.parallel import mesh as mesh_helpers
+        import jax.sharding as shd
+        buf = mesh_helpers.put_along(
+            self.mesh, np.asarray(array),
+            shd.PartitionSpec(self.mesh.axis_names[0]))
+        self.device.h2d_bytes += int(buf.nbytes)
+        return buf
+
+    def _put_replicated(self, array: np.ndarray):
+        """Replicate a host array over the engine's mesh (dataset,
+        targets, superstep indices/masks — multihost-safe placement),
+        or hand it through untouched off-mesh (the single-device jit
+        consumes host numpy directly, as before)."""
+        if not self.member_sharded:
+            return array
+        from veles_tpu.parallel import mesh as mesh_helpers
+        import jax.sharding as shd
+        return mesh_helpers.put_along(self.mesh, np.asarray(array),
+                                      shd.PartitionSpec())
+
+    def _zeros_members(self, shape):
+        if not self.member_sharded:
+            return self.device.zeros(shape, np.float32)
+        key = tuple(int(s) for s in shape)
+        fn = self._zeros_cache.get(key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+            from veles_tpu.parallel.mesh import member_sharding
+            fn = jax.jit(
+                lambda: jnp.zeros(key, jnp.float32),
+                out_shardings=member_sharding(self.mesh))
+            self._zeros_cache[key] = fn
+        return fn()
+
+    def _fresh_cohort_acc(self):
+        if not self.member_sharded:
+            return np.zeros((self._n_stacked, 3), np.float32)
+        return self._zeros_members((self._n_stacked, 3))
+
+    def _fetch_members(self, acc) -> np.ndarray:
+        """One (P, 3) metric fetch, REAL members only.  On a mesh the
+        member-sharded accumulator is first re-laid-out replicated (a
+        fully-replicated global array is host-fetchable from every
+        process — the multihost-safe materialization)."""
+        if self.member_sharded:
+            import jax
+            if self._replicate is None:
+                from veles_tpu.parallel.mesh import replicated_sharding
+                self._replicate = jax.jit(
+                    lambda a: a,
+                    out_shardings=replicated_sharding(self.mesh))
+            acc = self._replicate(acc)
+        return np.asarray(acc)[:self.n_members]
 
     # -- trace construction -------------------------------------------
 
@@ -1512,29 +1715,44 @@ class PopulationTrainEngine:
         min_valid_epoch = np.full(P, -1, np.int64)
         min_train = np.full(P, np.inf)
         complete = np.zeros(P, bool)
-        dataset = ld.original_data.unmap()
-        targets = self.fused._target_store()
+        if self.member_sharded:
+            # the engine owns its data placement on the mesh: the
+            # replicated copy lives next to the member-sharded stacks
+            # regardless of which single device built the workflow
+            dataset = self._put_replicated(ld.original_data.map_read())
+            tvec = ld.original_targets if self.fused._has_targets() \
+                else ld.original_labels
+            targets = self._put_replicated(tvec.map_read())
+        else:
+            dataset = ld.original_data.unmap()
+            targets = self.fused._target_store()
         params, opt, acc = self._params, self._opt, self._acc
         while not complete.all():
             ld.run()
             idxs, mask = ld.superstep_indices, ld.superstep_mask
             k = idxs.shape[0]
             klass = ld.minibatch_class
+            if klass == TRAIN or klass == VALID:
+                idx_dev = self._put_replicated(idxs)
+                mask_dev = self._put_replicated(mask)
             if klass == TRAIN:
                 params, opt, acc = self._train_step(
-                    params, opt, acc, self._member_lr(k), self._wd,
-                    dataset, targets, idxs, mask, self._rng_counter)
+                    params, opt, acc,
+                    self._put_members(self._member_lr(k)), self._wd,
+                    dataset, targets, idx_dev, mask_dev,
+                    self._rng_counter)
             elif klass == VALID:
                 acc = self._eval_step(params, acc, dataset, targets,
-                                      idxs, mask, self._rng_counter)
+                                      idx_dev, mask_dev,
+                                      self._rng_counter)
             # TEST firings never feed fitness: skip the dispatch but
             # keep the rng_counter advance so dropout streams stay
             # aligned with the oracle's firing count
             self._rng_counter += k
             if not bool(ld.class_ended):
                 continue
-            a = np.asarray(acc)          # one (P, 3) fetch per class
-            acc = np.zeros((P, 3), np.float32)
+            a = self._fetch_members(acc)  # one (P, 3) fetch per class
+            acc = self._fresh_cohort_acc()
             err = a[:, 0].astype(np.float64)
             live = ~complete
             if klass == VALID:
@@ -1569,6 +1787,8 @@ class PopulationTrainEngine:
         self._acc = None
         self._wd = None
         self._train_step = self._eval_step = None
+        self._zeros_cache.clear()
+        self._replicate = None
 
 
 #: back-compat alias — the chunk/pad helper moved to ops/batching.py

@@ -16,8 +16,13 @@ heterogeneous clusters (veles_tpu/server.py, veles_tpu/client.py).
 """
 
 from veles_tpu.parallel.mesh import (batch_sharding, make_mesh,
-                                     replicated_sharding)
+                                     member_sharding, padded_rows,
+                                     put_along, put_row_sharded,
+                                     replicated_sharding, row_sharding,
+                                     shard_mode)
 from veles_tpu.parallel.data_parallel import DataParallel, MeshJaxDevice
 
 __all__ = ["make_mesh", "batch_sharding", "replicated_sharding",
+           "row_sharding", "member_sharding", "padded_rows",
+           "put_along", "put_row_sharded", "shard_mode",
            "DataParallel", "MeshJaxDevice"]

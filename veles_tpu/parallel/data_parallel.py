@@ -20,12 +20,21 @@ from veles_tpu.parallel.mesh import make_mesh, replicated_sharding
 
 
 class MeshJaxDevice(JaxDevice):
-    """A JaxDevice whose buffers live replicated across a mesh.
+    """A JaxDevice whose buffers live on a mesh.
 
     ``put`` uploads host arrays with a fully-replicated NamedSharding so
     Vectors initialized through the normal ``Vector.initialize(device)``
     path are immediately consumable by the sharded step without a
-    resharding transfer.
+    resharding transfer.  ``put_sharded`` is the capacity placement:
+    the leading axis split 1/N per device (row-sharded residency,
+    member-sharded cohorts).
+
+    Transfer/residency accounting is PER-DEVICE-HONEST: a replicated
+    put physically lands one copy on EVERY device, so it charges
+    ``nbytes * n_devices`` against ``h2d_bytes``; a sharded put lands
+    ``total/N`` per device and charges the padded total once.  (The
+    original accounting charged replicated uploads at 1x — an 8-device
+    mesh looked as cheap as one chip while burning 8x HBM.)
     """
 
     backend_name = "mesh"
@@ -36,9 +45,14 @@ class MeshJaxDevice(JaxDevice):
         self.mesh = mesh
         self._repl = replicated_sharding(mesh)
         self._zeros_fn = None
+        self._zeros_sharded_fn = None
         platform = mesh.devices.flat[0].platform
         super().__init__(platform=platform, compute_dtype=compute_dtype)
         self._jax = jax
+
+    @property
+    def n_devices(self) -> int:
+        return int(self.mesh.devices.size)
 
     def put(self, array) -> Any:
         import numpy as np
@@ -47,21 +61,43 @@ class MeshJaxDevice(JaxDevice):
         # the sharded streaming path ships uint8 superstep batches
         # (each device receives only its slice of every minibatch)
         arr = np.array(array, copy=True)
-        self.h2d_bytes += arr.nbytes
+        # replicated = one physical copy PER device
+        self.h2d_bytes += arr.nbytes * self.n_devices
         return self._jax.device_put(arr, self._repl)
 
-    def zeros(self, shape, dtype=None) -> Any:
+    def put_sharded(self, array) -> Any:
+        """Upload with the leading axis split 1/N per device (rows
+        zero-padded to a whole per-device tile).  Total HBM across the
+        mesh is the padded array ONCE — per-device cost total/N — and
+        that is what ``h2d_bytes`` charges."""
+        import numpy as np
+
+        from veles_tpu.parallel import mesh as mesh_helpers
+        arr = np.asarray(array)
+        buf, _ = mesh_helpers.put_row_sharded(self.mesh, arr)
+        self.h2d_bytes += int(buf.nbytes)
+        return buf
+
+    def zeros(self, shape, dtype=None, sharded: bool = False) -> Any:
         import numpy as np
         if self._zeros_fn is None:
             import jax.numpy as jnp
-            # one jitted fn with static (shape, dtype): momentum
-            # allocation calls this once per parameter and a fresh
-            # lambda per call would defeat jit's cache (recompile each)
+            from veles_tpu.parallel.mesh import row_sharding
+            # one jitted fn per placement with static (shape, dtype):
+            # momentum allocation calls this once per parameter and a
+            # fresh lambda per call would defeat jit's cache
             self._zeros_fn = self._jax.jit(
                 lambda shape, dtype: jnp.zeros(shape, dtype),
                 static_argnums=(0, 1), out_shardings=self._repl)
+            self._zeros_sharded_fn = self._jax.jit(
+                lambda shape, dtype: jnp.zeros(shape, dtype),
+                static_argnums=(0, 1),
+                out_shardings=row_sharding(self.mesh))
         dtype = np.dtype(dtype if dtype is not None else np.float32)
-        return self._zeros_fn(tuple(int(s) for s in shape), dtype)
+        if isinstance(shape, (int, np.integer)):
+            shape = (shape,)
+        fn = self._zeros_sharded_fn if sharded else self._zeros_fn
+        return fn(tuple(int(s) for s in shape), dtype)
 
     def __repr__(self) -> str:
         n = self.mesh.devices.size

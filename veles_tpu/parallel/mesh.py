@@ -1,14 +1,35 @@
-"""Mesh construction and sharding helpers.
+"""Mesh construction and sharding helpers — the ONE sharding seam.
 
 One flat ``data`` axis covers the reference's capability surface (pure
 data parallelism, SURVEY.md §3.4 — no tensor/pipeline parallelism to
 reproduce).  Helpers return ``NamedSharding``s so call sites never
 touch PartitionSpec spelling.
+
+Three placements cover every engine (Lattice, ROADMAP items 2+4):
+
+- **replicated** (:func:`replicated_sharding`): every device holds the
+  full array — params, scalars, and datasets small enough to copy;
+- **row-sharded** (:func:`row_sharding`, :func:`put_row_sharded`):
+  each device holds 1/N of the leading-axis rows — the sharded
+  RESIDENCY placement, so dataset HBM capacity scales with the mesh
+  instead of multiplying by it;
+- **member-sharded** (:func:`member_sharding`): the stacked member
+  axis of a vmapped population/ensemble split 1/N per device — P/N
+  members per device, so cohort capacity scales with the mesh.
+
+Row- and member-sharding are the same PartitionSpec (leading axis over
+``data``); the two names exist because call sites mean different
+things by the leading axis and the seam should read as placement
+intent, not spelling.  Placement goes through
+``jax.make_array_from_callback`` so the same helpers work on a
+single-process virtual mesh (tests, ``dryrun_multichip``) and a
+multi-process/multi-host mesh (each process materializes only its
+addressable shards).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -46,3 +67,68 @@ def batch_sharding(mesh):
 
     return jax.sharding.NamedSharding(
         mesh, jax.sharding.PartitionSpec(mesh.axis_names[0]))
+
+
+def row_sharding(mesh):
+    """Dataset rows split 1/N per device — the sharded-residency
+    placement (each device's HBM holds ``padded_rows(R, N) / N``
+    rows).  Same spec as :func:`batch_sharding`; named for intent."""
+    return batch_sharding(mesh)
+
+
+def member_sharding(mesh):
+    """A stacked member axis split P/N per device — the population /
+    ensemble capacity placement (members are embarrassingly parallel,
+    so the partitioner moves no data between devices inside the
+    vmapped body)."""
+    return batch_sharding(mesh)
+
+
+def shard_mode(value: str) -> str:
+    """Normalize a ``VELES_MESH_SHARD_*`` knob/ctor value to one of
+    ``"never"`` / ``"auto"`` / ``"always"``."""
+    v = str(value).strip().lower()
+    if v in ("0", "never", "false", "no", "off"):
+        return "never"
+    if v in ("1", "always", "true", "yes", "on"):
+        return "always"
+    return "auto"
+
+
+def padded_rows(n_rows: int, n_devices: int) -> int:
+    """Leading-axis length after padding to a whole per-device tile
+    (the mask-tail convention: padded rows exist only as placement
+    filler — loader indices never reference them)."""
+    return -(-int(n_rows) // int(n_devices)) * int(n_devices)
+
+
+def put_along(mesh, array: np.ndarray, spec):
+    """Place a host array on the mesh under ``spec``, single- or
+    multi-process: ``make_array_from_callback`` asks each process for
+    its addressable shards only, so the same call works on a virtual
+    CPU mesh and a real multi-host slice (where a plain
+    ``device_put`` to non-addressable devices cannot)."""
+    import jax
+
+    array = np.ascontiguousarray(array)
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        array.shape, sharding, lambda idx: array[idx])
+
+
+def put_row_sharded(mesh, array: np.ndarray) -> Tuple[object, int]:
+    """Upload ``array`` with its leading axis row-sharded 1/N per
+    device, zero-padding the tail to a whole per-device tile.
+    Returns ``(jax array of padded_rows(R, N) rows, R)`` — callers
+    keep the real row count; consumers must never index past it."""
+    import jax
+
+    n = int(mesh.devices.size)
+    n_real = len(array)
+    pad = padded_rows(n_real, n) - n_real
+    if pad:
+        array = np.concatenate(
+            [array, np.zeros((pad,) + array.shape[1:], array.dtype)])
+    return put_along(
+        mesh, array,
+        jax.sharding.PartitionSpec(mesh.axis_names[0])), n_real

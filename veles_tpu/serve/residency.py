@@ -81,7 +81,13 @@ class ResidencyManager(Logger):
     @staticmethod
     def _device_budget(device: Any) -> int:
         """The device's reported HBM limit, else the declared knob
-        default — the same accounting the GA cohort sizing uses."""
+        default — the same accounting the GA cohort sizing uses.
+        PER DEVICE by construction: on a mesh device the probed
+        ``jax_device`` is one chip, and a replicated model costs its
+        full ``param_bytes`` on EVERY chip, so charging one device's
+        budget against one copy's bytes stays honest (the Lattice
+        convention — capacity multiplies only for SHARDED placements,
+        and served model params replicate)."""
         jdev = getattr(device, "jax_device", None)
         if jdev is not None:
             try:
