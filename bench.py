@@ -1098,6 +1098,132 @@ def online_metric(phase):
         return None
 
 
+def trace_metric(phase):
+    """Flightline tracing (ISSUE 16 acceptance): one single-replica
+    Swarm fleet over the tiny chaos-drill model, driven by the same
+    closed loop with ``$VELES_TRACE_SAMPLE`` flipped 1/0 between
+    interleaved sub-windows (the online_metric window-ordering-noise
+    defense: the ratio is the MEDIAN over window PAIRS, not one long
+    window each).  Bar: tracing-on p99 <= 1.05x tracing-off.  The
+    sampled windows' journals are then assembled offline
+    (obs.load_tree + assemble_traces) and the phase verifies the
+    traces are COMPLETE — root trace.request, a trace.leg, and a
+    cross-process trace.serve hop with a renderable critical path —
+    and that the p99 tail exemplar buckets name real trace ids."""
+    if os.environ.get("BENCH_SKIP_TRACE"):
+        return None
+    import tempfile
+
+    window = float(os.environ.get("BENCH_TRACE_WINDOW_SEC", "2"))
+    pairs = int(os.environ.get("BENCH_TRACE_PAIRS", "5"))
+    try:
+        from veles_tpu import telemetry
+        from veles_tpu.obs import (assemble_traces, critical_path,
+                                   load_tree, tail_exemplars)
+        from veles_tpu.serve.router import FleetRouter
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        from chaos_drill import _fleet_pkg
+
+        tmp = tempfile.mkdtemp(prefix="bench_trace_")
+        pkg, _oracle = _fleet_pkg(tmp)
+        mdir = os.path.join(tmp, "metrics")
+        phase("trace: spawning 1-replica fleet (tiny model)")
+        prev = os.environ.get("VELES_TRACE_SAMPLE")
+        router = FleetRouter(
+            {"m": pkg}, n_replicas=1, backend="cpu", max_batch=8,
+            max_wait_ms=2.0, metrics_dir=mdir,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        try:
+            rng = np.random.default_rng(7)
+            row = rng.standard_normal((1, 6, 6, 1)).astype(np.float32)
+            for _ in range(16):          # compile + steady state
+                r = router.request("m", row, timeout=120)
+                assert "error" not in r, r
+
+            def one_window(rate):
+                os.environ["VELES_TRACE_SAMPLE"] = str(rate)
+                lats = []
+                t_end = time.perf_counter() + window
+                while time.perf_counter() < t_end:
+                    t0 = time.perf_counter()
+                    r = router.request("m", row, timeout=60)
+                    assert "error" not in r, r
+                    lats.append(time.perf_counter() - t0)
+                return lats
+
+            one_window(0)                # order-noise burn-in
+            ratios, p_on, p_off, n_on = [], [], [], 0
+            for i in range(pairs):
+                on = one_window(1)
+                off = one_window(0)
+                n_on += len(on)
+                p1 = float(np.percentile(on, 99))
+                p0 = float(np.percentile(off, 99))
+                p_on.append(p1)
+                p_off.append(p0)
+                ratios.append(p1 / max(p0, 1e-9))
+                phase(f"trace: pair {i + 1}/{pairs} p99 "
+                      f"{1000 * p1:.2f}ms on / {1000 * p0:.2f}ms off "
+                      f"({p1 / max(p0, 1e-9):.3f}x)")
+            ratio = float(np.median(ratios))
+        finally:
+            if prev is None:
+                os.environ.pop("VELES_TRACE_SAMPLE", None)
+            else:
+                os.environ["VELES_TRACE_SAMPLE"] = prev
+            router.close()
+            telemetry.flush()
+
+        reg, merged = load_tree(mdir)
+        traces = assemble_traces(merged)
+        complete = 0
+        for evs in traces.values():
+            names = {e.get("event") for e in evs}
+            if not {"trace.request", "trace.leg",
+                    "trace.serve"} <= names:
+                continue
+            if len({e.get("_pid") for e in evs}) < 2:
+                continue        # router + replica: cross-process
+            cp = critical_path(evs)
+            if cp.get("total_s") is not None \
+                    and cp.get("dispatch_s") is not None:
+                complete += 1
+        assembly_ok = bool(traces) and complete >= int(
+            0.9 * len(traces))
+        hist = (reg.snapshot().get("histograms") or {}).get(
+            "fleet.request_seconds") or {}
+        tail = tail_exemplars(reg, "fleet.request_seconds")
+        out = {
+            "trace_overhead_p99_ratio": round(ratio, 3),
+            "trace_overhead_ok": bool(ratio <= 1.05),
+            "trace_p99_ms_on": round(
+                1000 * float(np.median(p_on)), 3),
+            "trace_p99_ms_off": round(
+                1000 * float(np.median(p_off)), 3),
+            "trace_sampled_requests": n_on,
+            "trace_assembled": len(traces),
+            "trace_assembled_complete": complete,
+            "trace_assembly_ok": bool(assembly_ok),
+            "trace_exemplar_buckets": len(hist.get("exemplars")
+                                          or {}),
+            "trace_tail_exemplars": len(tail),
+            "trace_window_sec": window,
+            "trace_window_pairs": pairs,
+            "trace_platform": "cpu",
+        }
+        phase(f"trace: p99 ratio {ratio:.3f}x "
+              f"({'<=' if out['trace_overhead_ok'] else 'OVER'} "
+              f"1.05 bar), {complete}/{len(traces)} traces complete "
+              f"cross-process, {out['trace_exemplar_buckets']} "
+              f"exemplar bucket(s), {len(tail)} in the p99 tail")
+        return out
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"trace metric failed: {e}", file=sys.stderr)
+        return None
+
+
 def fleet_metric(phase):
     """Swarm fleet serving (ISSUE 11 acceptance): sustained QPS vs
     replica count (1/2/4 replicas over the SAME model set, XLA:CPU),
@@ -2210,6 +2336,18 @@ def main() -> None:
                   file=sys.stderr, flush=True)
         print(json.dumps(online_metric(_phase)), flush=True)
         return
+    if "--trace-only" in sys.argv:
+        # fast path: ONLY the Flightline tracing phase (one XLA:CPU
+        # replica) — the ISSUE 16 acceptance gate (tracing-on p99 <=
+        # 1.05x off, cross-process assembly, p99 exemplars) without
+        # the headline build
+        t0 = time.perf_counter()
+
+        def _phase(msg):
+            print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}",
+                  file=sys.stderr, flush=True)
+        print(json.dumps(trace_metric(_phase)), flush=True)
+        return
     if "--fleet-only" in sys.argv:
         # fast path: ONLY the Swarm fleet phase (N XLA:CPU replica
         # subprocesses) — the ISSUE 11 acceptance gate (replica-count
@@ -2428,6 +2566,19 @@ def main() -> None:
         "online_window_sec": None,
         "online_buffer_bytes": None,
         "online_platform": None,
+        "trace_overhead_p99_ratio": None,
+        "trace_overhead_ok": None,
+        "trace_p99_ms_on": None,
+        "trace_p99_ms_off": None,
+        "trace_sampled_requests": None,
+        "trace_assembled": None,
+        "trace_assembled_complete": None,
+        "trace_assembly_ok": None,
+        "trace_exemplar_buckets": None,
+        "trace_tail_exemplars": None,
+        "trace_window_sec": None,
+        "trace_window_pairs": None,
+        "trace_platform": None,
         "mesh_devices": None,
         "mesh_platform": None,
         "mesh_dataset_rows": None,
@@ -2550,6 +2701,13 @@ def main() -> None:
     ol = online_metric(phase)
     if ol:
         record.update(ol)
+    emit()
+
+    phase("measuring tracing overhead + assembly (Flightline, "
+          "1-replica fleet)")
+    tr = trace_metric(phase)
+    if tr:
+        record.update(tr)
     emit()
 
     phase(f"measuring mesh sharding (Lattice, forced {MESH_DEVICES}-"

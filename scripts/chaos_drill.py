@@ -1124,6 +1124,66 @@ def main(argv=None) -> int:
         log(f"lock witness: {len(observed)} observed edge(s), all "
             f"declared in the locking law")
     ok = ok and witness_ok
+
+    # Flightline pass: the flight recorder is always armed, so every
+    # ejection / promotion / rollback the matrix provoked must have
+    # left a flightrec-*.json dump next to its journal — a verdict
+    # with no dump means the crash-proof ring is not actually wired
+    # to that trigger
+    import glob as _glob
+
+    from veles_tpu import events as _events
+    reason_of = {_events.EV_FLEET_REPLICA_EJECTED: "ejection",
+                 _events.EV_ONLINE_PROMOTED: "promote",
+                 _events.EV_ONLINE_ROLLBACK: "rollback"}
+    dirs = []
+    for mdir in [telemetry.metrics_dir()] + WITNESS_DIRS:
+        if mdir and os.path.isdir(mdir):
+            real = os.path.realpath(mdir)
+            if real not in dirs:
+                dirs.append(real)
+    # drop dirs nested under another (the recursive walk below would
+    # double count their journals and dumps)
+    dirs = [d for d in dirs
+            if not any(d != o and (d + os.sep).startswith(o + os.sep)
+                       for o in dirs)]
+    need: dict = {}
+    dump_reasons: list = []
+    for mdir in dirs:
+        for jf in _glob.glob(os.path.join(mdir, "**",
+                                          "journal-*.jsonl"),
+                             recursive=True):
+            try:
+                with open(jf) as f:
+                    for line in f:
+                        try:
+                            ev = json.loads(line)
+                        except ValueError:
+                            continue
+                        r = reason_of.get(ev.get("event"))
+                        if r:
+                            need[r] = need.get(r, 0) + 1
+            except OSError:
+                continue
+        for fp in _glob.glob(os.path.join(mdir, "**",
+                                          "flightrec-*.json"),
+                             recursive=True):
+            try:
+                with open(fp) as f:
+                    dump_reasons.append(json.load(f).get("reason"))
+            except (OSError, ValueError):
+                continue
+    missing = {r: n for r, n in sorted(need.items())
+               if dump_reasons.count(r) < n}
+    flightrec_ok = not missing
+    if missing:
+        log(f"FLIGHT RECORDER: events without a matching dump "
+            f"{missing} (dumps on disk: {sorted(dump_reasons)})")
+    else:
+        log(f"flight recorder: {len(dump_reasons)} dump(s) cover "
+            f"{sum(need.values())} eject/promote/rollback event(s)")
+    ok = ok and flightrec_ok
+
     record = {
         "fault_drill_ok": ok,
         "fault_drill_journal_verified": bool(results) and all(
@@ -1131,6 +1191,8 @@ def main(argv=None) -> int:
             for r in results),
         "lock_witness_ok": witness_ok,
         "lock_witness_edges": len(observed),
+        "flight_recorder_ok": flightrec_ok,
+        "flight_recorder_dumps": len(dump_reasons),
         "results": results,
     }
     print(json.dumps(record), flush=True)

@@ -26,9 +26,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-from veles_tpu.obs import (fleet_model_rows, fleet_rows,  # noqa: E402
-                           learner_rows, load_dir, render,
-                           render_fleet)
+from veles_tpu.obs import (assemble_traces,  # noqa: E402
+                           fleet_model_rows, fleet_rows, learner_rows,
+                           load_dir, load_tree, render, render_fleet,
+                           render_trace)
 from veles_tpu.telemetry import Histogram  # noqa: E402
 
 
@@ -42,6 +43,13 @@ def main(argv=None) -> int:
                         "(pid, resident models, queue depth, qps, "
                         "p99) from the replica-* child dirs plus the "
                         "per-model canary traffic split")
+    p.add_argument("--trace", metavar="TRACE_ID", default=None,
+                   help="render ONE assembled Flightline trace (hop "
+                        "timeline + critical path) by trace id; "
+                        "merges the replica-* child journals")
+    p.add_argument("--traces", action="store_true",
+                   help="list every assembled trace id with its "
+                        "outcome and total latency, slowest first")
     p.add_argument("--events", type=int, default=40,
                    help="timeline length (default 40)")
     args = p.parse_args(argv)
@@ -50,6 +58,32 @@ def main(argv=None) -> int:
         print(f"obs_report: {args.metrics_dir!r} is not a directory",
               file=sys.stderr)
         return 2
+    if args.trace or args.traces:
+        from veles_tpu.obs import critical_path
+        _reg, merged = load_tree(args.metrics_dir)
+        traces = assemble_traces(merged)
+        if args.trace:
+            evs = traces.get(args.trace)
+            if not evs:
+                print(f"obs_report: no events for trace "
+                      f"{args.trace!r} (have {len(traces)} traces)",
+                      file=sys.stderr)
+                return 1
+            print(render_trace(evs))
+            return 0
+        rows = sorted((critical_path(evs) for evs in traces.values()),
+                      key=lambda c: c.get("total_s") or 0.0,
+                      reverse=True)
+        for cp in rows:
+            total = cp.get("total_s")
+            print(f"{cp.get('trace')}  {cp.get('model') or '-':<12} "
+                  f"{cp.get('outcome') or '-':<8} "
+                  f"{1000.0 * total:9.2f}ms  legs={cp['legs']}"
+                  f"{' hedged' if cp['hedged'] else ''}"
+                  f"{' retried' if cp['retried'] else ''}"
+                  if total is not None else
+                  f"{cp.get('trace')}  (no root event)")
+        return 0
     reg, snaps, journals, events = load_dir(args.metrics_dir)
     if not snaps and not events \
             and not fleet_rows(args.metrics_dir):

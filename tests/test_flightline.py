@@ -1,0 +1,495 @@
+"""Flightline (ISSUE 16): fleet-wide causal tracing + the crash-proof
+flight recorder.
+
+Unit tier: context minting (error-diffusion sampling is EXACT), wire
+round-trips, the always-armed ring + atomic dumps, the journal's
+monotonic skew correction, histogram tail exemplars, the critical-path
+decomposition, and the veleslint rule pinning trace wire keys to the
+protocol registry.
+
+Integration tier: REAL fleets (router + replica subprocesses — three
+or more processes per assembled trace).  A hedged request must
+assemble into ONE trace with BOTH legs recorded and the winner
+attributed; a SIGKILL failover retry must share the original
+trace_id; a slow replica's ejection must leave a flight-recorder
+dump on disk.
+"""
+
+import glob
+import json
+import os
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from veles_tpu import events, telemetry, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestTraceContext:
+    def test_mint_samples_exact_fraction_by_error_diffusion(self):
+        env = {"VELES_TRACE_SAMPLE": "0.25"}
+        hits = sum(trace.mint(env).sampled for _ in range(400))
+        # error diffusion, not a coin flip: the fraction is EXACT
+        # (+-1 for the accumulator's resident remainder)
+        assert abs(hits - 100) <= 1
+
+    def test_mint_rate_bounds(self):
+        assert not trace.mint({"VELES_TRACE_SAMPLE": "0"}).sampled
+        assert trace.mint({"VELES_TRACE_SAMPLE": "1"}).sampled
+        # malformed falls back to the default (1.0), never raises
+        assert trace.mint({"VELES_TRACE_SAMPLE": "bogus"}).sampled
+
+    def test_child_keeps_trace_parents_span(self):
+        root = trace.TraceContext("aa" * 8)
+        kid = root.child()
+        assert kid.trace_id == root.trace_id
+        assert kid.parent_id == root.span_id
+        assert kid.span_id != root.span_id
+        assert kid.sampled == root.sampled
+
+    def test_wire_round_trip(self):
+        ctx = trace.TraceContext("ab" * 8, "cd" * 4, "ef" * 4)
+        msg = trace.to_wire({"cmd": "request"}, ctx)
+        assert msg["trace"] == ctx.trace_id
+        assert msg["span"] == ctx.span_id
+        assert msg["parent"] == ctx.parent_id
+        back = trace.from_wire(msg)
+        assert (back.trace_id, back.span_id, back.parent_id) == \
+            (ctx.trace_id, ctx.span_id, ctx.parent_id)
+        assert back.sampled
+
+    def test_unsampled_context_never_rides_the_wire(self):
+        ctx = trace.TraceContext("ab" * 8, sampled=False)
+        msg = trace.to_wire({"cmd": "request"}, ctx)
+        assert set(msg) == {"cmd"}     # rate 0 adds ZERO bytes
+        assert trace.from_wire(msg) is None
+        assert trace.from_wire({"cmd": "x"}) is None
+
+    def test_use_parks_thread_locally_and_restores(self):
+        ctx = trace.TraceContext("ab" * 8)
+        assert trace.current() is None
+        with trace.use(ctx):
+            assert trace.current() is ctx
+            seen = []
+            t = threading.Thread(
+                target=lambda: seen.append(trace.current()))
+            t.start()
+            t.join()
+            assert seen == [None]      # thread-local, not global
+        assert trace.current() is None
+
+    def test_journaled_events_auto_carry_the_current_trace(self):
+        ctx = trace.TraceContext("ab" * 8)
+        with trace.use(ctx):
+            telemetry.event("flightline.probe", detail=1)
+        ev = telemetry.recent_events("flightline.probe")[-1]
+        assert ev["trace"] == ctx.trace_id
+        assert ev["span"] == ctx.span_id
+        # explicit caller fields WIN over the provider
+        with trace.use(ctx):
+            telemetry.event("flightline.probe2", trace="override")
+        assert telemetry.recent_events(
+            "flightline.probe2")[-1]["trace"] == "override"
+
+
+class TestFlightRecorder:
+    def test_ring_records_without_io_and_dump_is_atomic(
+            self, tmp_path):
+        telemetry.configure(str(tmp_path))
+        ctx = trace.TraceContext("ab" * 8)
+        trace.record("probe.hop", ctx=ctx, replica=3)
+        entries = trace.ring_entries()
+        assert entries[-1]["ev"] == "probe.hop"
+        assert entries[-1]["trace"] == ctx.trace_id
+        assert entries[-1]["replica"] == 3
+        path = trace.dump("unit test/../reason")
+        assert path and os.path.isfile(path)
+        # the reason is sanitized into the filename
+        assert "unit_test_.._reason" in os.path.basename(path)
+        payload = json.load(open(path))
+        assert payload["pid"] == os.getpid()
+        assert any(e["ev"] == "probe.hop" for e in payload["ring"])
+        assert "journal_tail" in payload
+        # no torn dump tempfile left behind (the background metrics
+        # flush may legitimately have its own metrics-*.tmp in flight)
+        assert not glob.glob(str(tmp_path / "flightrec-*.tmp"))
+        # the dump itself is journaled
+        assert telemetry.recent_events(events.EV_FLIGHTREC_DUMP)
+
+    def test_dump_without_metrics_dir_is_a_noop(self):
+        assert trace.dump("nowhere") is None
+
+
+class TestSkewCorrection:
+    def test_interleaving_follows_monotonic_not_wall_clock(
+            self, tmp_path):
+        """Two processes whose wall clocks disagree by 10s: the merged
+        timeline must order events by the per-pid skew-corrected
+        monotonic stamp, not the raw ``ts`` (satellite: the journal
+        interleaving bug)."""
+        from veles_tpu.obs import load_dir
+        a = [{"ts": 1000.0 + i, "mono": 5.0 + i, "event": f"a{i}"}
+             for i in range(3)]
+        # pid B's wall clock runs 10s AHEAD but its events really
+        # happened BETWEEN pid A's (mono 5.5, 6.5)
+        b = [{"ts": 1010.5, "mono": 5.5, "event": "b0"},
+             {"ts": 1011.5, "mono": 6.5, "event": "b1"}]
+        with open(tmp_path / "journal-111.jsonl", "w") as f:
+            f.writelines(json.dumps(e) + "\n" for e in a)
+        with open(tmp_path / "journal-222.jsonl", "w") as f:
+            f.writelines(json.dumps(e) + "\n" for e in b)
+        _reg, _snaps, _journals, evs = load_dir(str(tmp_path))
+        order = [e["event"] for e in evs]
+        assert order == ["a0", "b0", "a1", "b1", "a2"]
+        # raw-ts ordering (the old bug) would have pushed b* last
+        assert sorted(order, key=lambda n: dict(
+            (e["event"], e["ts"]) for e in a + b)[n])[-2:] == \
+            ["b0", "b1"]
+
+
+class TestTailExemplars:
+    def test_exemplars_survive_snapshot_merge_and_name_the_tail(
+            self, tmp_path):
+        from veles_tpu.obs import tail_exemplars
+        from veles_tpu.telemetry import Registry
+        h = telemetry.histogram("probe.seconds")
+        for _ in range(200):
+            h.record(0.001)
+        h.record(0.5, exemplar="feedbeef" * 2)       # the p99 tail
+        h.record(0.0001, exemplar="aa" * 8)          # deep body
+        merged = Registry()
+        merged.merge_snapshot(telemetry.snapshot())
+        tail = tail_exemplars(merged, "probe.seconds", q=0.99)
+        assert ("feedbeef" * 2) in [t for _, t in tail]
+        # the deep-body exemplar (its bucket sits entirely below the
+        # p99 threshold) is NOT in the tail
+        assert ("aa" * 8) not in [t for _, t in tail]
+
+    def test_unsampled_records_leave_no_exemplar(self):
+        h = telemetry.histogram("probe2.seconds")
+        h.record(0.1, exemplar=None)
+        assert h.exemplars == {}
+
+
+class TestCriticalPath:
+    def _trace(self):
+        tid = "ab" * 8
+        return [
+            {"event": "trace.request", "trace": tid, "span": "r1",
+             "model": "m", "outcome": "ok", "seconds": 0.010,
+             "_t": 1.0, "_pid": "1"},
+            {"event": "trace.leg", "trace": tid, "span": "l1",
+             "parent": "r1", "replica": 1, "verdict": "ok",
+             "seconds": 0.009, "hedge": False, "winner": True,
+             "_t": 1.001, "_pid": "1"},
+            {"event": "trace.serve", "trace": tid, "span": "s1",
+             "parent": "l1", "label": "m", "rows": 1,
+             "wait_s": 0.002, "dispatch_s": 0.004, "total_s": 0.007,
+             "_t": 1.002, "_pid": "2", "_replica": 1},
+        ]
+
+    def test_decomposition(self):
+        from veles_tpu.obs import critical_path
+        cp = critical_path(self._trace())
+        assert cp["outcome"] == "ok"
+        assert cp["legs"] == 1 and not cp["hedged"] \
+            and not cp["retried"]
+        assert cp["replica"] == 1
+        assert cp["pre_route_s"] == pytest.approx(0.001)
+        assert cp["wire_s"] == pytest.approx(0.002)
+        assert cp["batch_wait_s"] == pytest.approx(0.002)
+        assert cp["dispatch_s"] == pytest.approx(0.004)
+
+    def test_render_trace_indents_and_names_the_dominant_hop(self):
+        from veles_tpu.obs import render_trace
+        text = render_trace(self._trace())
+        assert "ab" * 8 in text
+        assert "trace.serve" in text
+        assert "critical path" in text
+        assert "dispatch" in text        # 4ms dominates
+
+
+class TestTraceWireKeyRule:
+    def _check(self, source, path="veles_tpu/trace.py"):
+        from veles_tpu.analysis.concurrency import TraceWireKeyRule
+        from veles_tpu.analysis.engine import Config, ModuleContext
+        return TraceWireKeyRule().check(
+            ModuleContext(path, source, Config()))
+
+    def test_real_trace_module_is_clean(self):
+        src = open(os.path.join(REPO, "veles_tpu", "trace.py")).read()
+        assert self._check(src) == []
+
+    def test_unregistered_wire_field_is_flagged_zero_waivers(self):
+        bad = textwrap.dedent("""
+            K_TRACE = "trace"
+            WIRE_FIELDS = ("trace", "smuggled_key")
+        """)
+        findings = self._check(bad)
+        assert findings, "unregistered wire key must be flagged"
+        assert any("smuggled_key" in f.message for f in findings)
+
+    def test_missing_wire_fields_tuple_is_flagged(self):
+        findings = self._check("K_TRACE = 'trace'\n")
+        assert findings
+
+    def test_other_files_are_ignored(self):
+        assert self._check("WIRE_FIELDS = ('bogus',)",
+                           path="veles_tpu/other.py") == []
+
+
+class TestLoggerJournal:
+    def test_warnings_route_to_the_journal_and_keep_stderr(self):
+        import logging
+
+        from veles_tpu.logger import (Logger, _HookHandler,
+                                      setup_logging)
+        setup_logging()
+
+        class Unit(Logger):
+            pass
+
+        u = Unit()
+        u.warning("flightline probe %d", 7)
+        u.info("below the threshold")
+        evs = telemetry.recent_events(events.EV_LOG_RECORD)
+        assert any(e["message"] == "flightline probe 7"
+                   and e["level"] == "WARNING" for e in evs)
+        assert not any(e.get("message") == "below the threshold"
+                       for e in evs)
+        # the console path is PRESERVED — the journal route rides a
+        # SEPARATE handler next to the stderr one, on both namespaces
+        vlog = logging.getLogger("veles")
+        assert any(type(h) is logging.StreamHandler
+                   for h in vlog.handlers)
+        assert any(isinstance(h, _HookHandler)
+                   for h in vlog.handlers)
+        flog = logging.getLogger("veles_tpu")
+        assert any(type(h) is logging.StreamHandler
+                   for h in flog.handlers)
+        # propagate untouched: pytest caplog and operator root
+        # configs keep seeing veles_tpu.* records
+        assert flog.propagate
+        # the warning also lands in the flight-recorder ring
+        assert any(e["ev"] == "log.warning"
+                   for e in trace.ring_entries())
+
+
+WF_TEXT = textwrap.dedent("""
+    from veles_tpu import prng
+    from veles_tpu.datasets import synthetic_classification
+    from veles_tpu.loader import ArrayLoader
+    from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+    def create_workflow(launcher):
+        prng.seed_all(4242)
+        train, valid, _ = synthetic_classification(
+            64, 16, (6, 6, 1), n_classes=3, seed=5)
+        return StandardWorkflow(
+            loader_factory=lambda w: ArrayLoader(
+                w, train=train, valid=valid, minibatch_size=16,
+                name="loader"),
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 12},
+                 "<-": {"learning_rate": 0.1}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.1}},
+            ],
+            decision_config={"max_epochs": 2}, name="flightline_wf")
+""")
+
+
+def _build_package(d, name, seed, n_members=3):
+    from veles_tpu import prng
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.ensemble.packaging import pack_ensemble
+    from veles_tpu.launcher import load_workflow_module
+
+    wf_path = os.path.join(d, f"wf_{name}.py")
+    with open(wf_path, "w") as f:
+        f.write(WF_TEXT)
+    mod = load_workflow_module(wf_path)
+
+    class FL:
+        workflow = None
+
+    prng.seed_all(seed)
+    w = mod.create_workflow(FL())
+    w.initialize(device=NumpyDevice())
+    base = {fw.name: {k: np.asarray(v) for k, v in
+                      fw.gather_params().items()}
+            for fw in w.forwards}
+    rng = np.random.default_rng(seed)
+    members = []
+    for _ in range(n_members):
+        params = {fn: {pn: (a + 0.05 * rng.standard_normal(a.shape)
+                            .astype(np.float32))
+                       for pn, a in p.items()}
+                  for fn, p in base.items()}
+        members.append({"params": params, "valid_error": 0.0,
+                        "seed": seed,
+                        "forward_names": [fw.name
+                                          for fw in w.forwards],
+                        "values": None})
+    pkg = os.path.join(d, f"{name}.vpkg")
+    pack_ensemble(pkg, name, members, wf_path)
+    return pkg
+
+
+@pytest.fixture(scope="module")
+def package(tmp_path_factory):
+    return _build_package(
+        str(tmp_path_factory.mktemp("flightline_pkgs")), "alpha", 11)
+
+
+def _assembled(mdir):
+    from veles_tpu.obs import assemble_traces, load_tree
+    telemetry.flush()
+    _reg, merged = load_tree(mdir)
+    return assemble_traces(merged), merged
+
+
+class TestHedgedTraceAssembly:
+    """One hedged request = ONE trace across >= 3 real processes
+    (router + 2 replicas), both legs recorded, winner attributed; the
+    slow replica's eventual ejection leaves a flight-recorder dump."""
+
+    def test_hedged_request_assembles_into_one_trace(
+            self, package, tmp_path_factory):
+        from veles_tpu.obs import critical_path, render_trace
+        from veles_tpu.serve.router import FleetRouter
+        mdir = str(tmp_path_factory.mktemp("flightline_hedge"))
+        router = FleetRouter(
+            {"alpha": package}, n_replicas=2, backend="cpu",
+            max_batch=16, max_wait_ms=5, metrics_dir=mdir, cwd=REPO,
+            deadline_ms=8000, hedge_min_ms=60, hedge_budget=1.0,
+            eject_threshold=4,
+            env_overrides={0: {"VELES_FAULTS":
+                               "hive.slow_dispatch@label=alpha"
+                               "&times=8&seconds=1.5"}})
+        try:
+            x = np.ones((1, 6, 6, 1), np.float32)
+            hedges0 = telemetry.counter("fleet.hedge.issued").value
+            for _ in range(24):
+                r = router.request("alpha", x, timeout=60)
+                assert "probs" in r, r
+                if telemetry.counter(
+                        "fleet.hedge.issued").value > hedges0:
+                    break
+            assert telemetry.counter(
+                "fleet.hedge.issued").value > hedges0
+            # let ejection strikes accrue, then drain the late losers
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and telemetry.counter(
+                    "fleet.eject.total").value < 1:
+                router.request("alpha", x, timeout=60)
+                time.sleep(0.05)
+        finally:
+            router.close()
+
+        traces, merged = _assembled(mdir)
+        # >= 3 processes contributed to the merged timeline
+        assert len({e.get("_pid") for e in merged
+                    if e.get("_pid")}) >= 3
+        hedged = [evs for evs in traces.values()
+                  if sum(1 for e in evs
+                         if e.get("event") == "trace.leg"
+                         and e.get("hedge")) >= 1]
+        assert hedged, "no hedged trace assembled"
+        evs = hedged[0]
+        legs = [e for e in evs if e.get("event") == "trace.leg"]
+        assert len(legs) >= 2              # BOTH attempts recorded
+        winners = [e for e in legs if e.get("winner")]
+        assert len(winners) == 1           # winner attributed once
+        tids = {e.get("trace") for e in evs}
+        assert len(tids) == 1              # ONE trace
+        root = [e for e in evs
+                if e.get("event") == "trace.request"]
+        assert len(root) == 1
+        # every leg parents on the root span
+        assert all(leg.get("parent") == root[0]["span"]
+                   for leg in legs)
+        cp = critical_path(evs)
+        assert cp["hedged"] and cp["legs"] >= 2
+        assert cp["total_s"] is not None
+        # the hedge fired after hedge_min_ms: visible as pre-route
+        assert cp["pre_route_s"] is None or cp["pre_route_s"] >= 0
+        text = render_trace(evs)
+        assert evs[0]["trace"] in text and "critical path" in text
+
+        # the ejection left a crash-proof dump in the router's dir
+        dumps = glob.glob(os.path.join(mdir, "**",
+                                       "flightrec-*-ejection.json"),
+                          recursive=True)
+        assert dumps, "ejection produced no flight-recorder dump"
+        payload = json.load(open(dumps[0]))
+        assert payload["reason"] == "ejection"
+        assert any(e.get("ev") == "sentinel.eject"
+                   for e in payload["ring"])
+
+
+class TestFailoverTraceAssembly:
+    """SIGKILL the primary mid-request: the retry on the healthy peer
+    shares the ORIGINAL trace_id — died leg + winning leg in one
+    assembled trace."""
+
+    def test_failover_retry_shares_the_trace_id(
+            self, package, tmp_path_factory):
+        from veles_tpu.serve.router import FleetRouter
+        mdir = str(tmp_path_factory.mktemp("flightline_kill"))
+        router = FleetRouter(
+            {"alpha": package}, n_replicas=2, backend="cpu",
+            max_batch=16, max_wait_ms=5, metrics_dir=mdir, cwd=REPO,
+            respawn_backoff=0.25)
+        try:
+            x = np.ones((2, 6, 6, 1), np.float32)
+            assert "probs" in router.request("alpha", x)   # warm
+            retries0 = telemetry.counter("fleet.retries").value
+            results, errs = [], []
+            per_worker = 12
+
+            def worker(i):
+                try:
+                    for k in range(per_worker):
+                        if i == 0 and k == 2:
+                            router.replicas[0].client.proc.kill()
+                        results.append(
+                            router.request("alpha", x, timeout=60))
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs
+            assert all("probs" in r for r in results)
+            assert telemetry.counter("fleet.retries").value > retries0
+        finally:
+            router.close(kill=True)
+
+        traces, _merged = _assembled(mdir)
+        retried = []
+        for evs in traces.values():
+            legs = [e for e in evs if e.get("event") == "trace.leg"
+                    and not e.get("hedge")]
+            if len(legs) >= 2 and any(
+                    e.get("verdict") == "died" for e in legs):
+                retried.append((evs, legs))
+        assert retried, \
+            "no trace carries both the died leg and its retry"
+        evs, legs = retried[0]
+        assert len({e.get("trace") for e in evs}) == 1
+        # the retry WON on the surviving peer
+        winners = [e for e in legs if e.get("winner")]
+        assert winners and winners[0]["verdict"] == "ok"
+        died = [e for e in legs if e.get("verdict") == "died"]
+        assert died[0]["replica"] != winners[0]["replica"]

@@ -189,6 +189,68 @@ class WireProtocolRule:
         return out
 
 
+class TraceWireKeyRule:
+    """veles_tpu/trace.py deliberately does NOT import
+    serve/protocol.py (it must stay import-light for the GA worker
+    and telemetry consumers), so its trace-propagation field names are
+    duplicated literals.  This rule is the static pin that makes the
+    duplication safe: every string in trace.py's ``WIRE_FIELDS``
+    tuple (and every ``K_*`` field constant) must be declared in the
+    serve/protocol.py wire-key registry — zero waivers, so a trace
+    context key can never ride the wire undeclared."""
+
+    name = "trace-wire-key"
+    doc = ("trace-propagation field in veles_tpu/trace.py "
+           "(WIRE_FIELDS / K_* literals) that is not declared in the "
+           "serve/protocol.py wire-key registry")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        norm = ctx.path.replace("\\", "/")
+        if not norm.endswith("veles_tpu/trace.py"):
+            return []
+        from veles_tpu.serve import protocol
+        out: List[Finding] = []
+        saw_wire_fields = False
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.Assign) \
+                    or len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name):
+                continue
+            tname = node.targets[0].id
+            if tname == "WIRE_FIELDS" \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                saw_wire_fields = True
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str) \
+                            and not protocol.known(elt.value):
+                        out.append(Finding(
+                            self.name, ctx.path, elt.lineno,
+                            elt.col_offset, elt.value,
+                            f"trace wire field {elt.value!r} is not "
+                            f"in the serve/protocol.py registry — "
+                            f"declare it there (zero waivers: an "
+                            f"undeclared propagation key is silently "
+                            f"dropped by readers)"))
+            elif tname.startswith("K_") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str) \
+                    and not protocol.known(node.value.value):
+                out.append(Finding(
+                    self.name, ctx.path, node.lineno,
+                    node.col_offset, node.value.value,
+                    f"trace field constant {tname} = "
+                    f"{node.value.value!r} is not in the "
+                    f"serve/protocol.py registry"))
+        if not saw_wire_fields:
+            out.append(Finding(
+                self.name, ctx.path, 1, 0, "WIRE_FIELDS",
+                "veles_tpu/trace.py must pin its propagation keys in "
+                "a module-level WIRE_FIELDS tuple for this rule to "
+                "cross-check against serve/protocol.py"))
+        return out
+
+
 # -- whole-program rules -----------------------------------------------
 
 class BlockingUnderLockRule:

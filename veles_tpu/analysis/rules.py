@@ -424,6 +424,7 @@ from veles_tpu.analysis.concurrency import (  # noqa: E402 — the
     # it cannot be imported before them
     PROJECT_RULES,
     ThreadLifecycleRule,
+    TraceWireKeyRule,
     WireProtocolRule,
 )
 
@@ -436,6 +437,7 @@ RULES = [
     LockDisciplineRule(),
     ThreadLifecycleRule(),
     WireProtocolRule(),
+    TraceWireKeyRule(),
 ]
 
 

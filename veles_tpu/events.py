@@ -121,6 +121,13 @@ EV_ONLINE_GATE = _ev("online.gate")
 EV_ONLINE_PROMOTED = _ev("online.promoted")
 EV_ONLINE_ROLLBACK = _ev("online.rollback")
 
+EV_TRACE_REQUEST = _ev("trace.request")
+EV_TRACE_LEG = _ev("trace.leg")
+EV_TRACE_SERVE = _ev("trace.serve")
+EV_TRACE_BATCH = _ev("trace.batch")
+EV_FLIGHTREC_DUMP = _ev("flightrec.dump")
+EV_LOG_RECORD = _ev("log.record")
+
 EV_SUPERVISOR_RESTART = _ev("supervisor.restart")
 EV_SUPERVISOR_RESUMED = _ev("supervisor.resumed")
 EV_SUPERVISOR_SHUTDOWN = _ev("supervisor.shutdown")

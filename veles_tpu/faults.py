@@ -295,6 +295,16 @@ def maybe_inject_child_crash(**ctx: Any) -> None:
     if fire("supervisor.child_crash", **ctx):
         import signal
         import sys as _sys
+        try:
+            # the flight recorder's whole reason to exist: SIGKILL
+            # skips atexit and the telemetry flush, so the ring is
+            # written NOW or never (lazy import — faults loads before
+            # almost everything, and a failed dump must not soften
+            # the crash being rehearsed)
+            from veles_tpu import trace as _trace
+            _trace.dump("sigkill")
+        except Exception:  # noqa: BLE001
+            pass
         _sys.stderr.flush()
         os.kill(os.getpid(), signal.SIGKILL)
 

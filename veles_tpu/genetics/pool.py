@@ -53,7 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu import events, telemetry
+from veles_tpu import events, telemetry, trace
 from veles_tpu.analysis import witness
 from veles_tpu.logger import Logger
 
@@ -333,6 +333,10 @@ class ChipEvaluatorPool(Logger):
             job = {"id": jid, "values": values, "seed": self.seed}
             if gen is not None:
                 job["gen"] = int(gen)
+            # each genome job is a trace root: the evaluator's spans
+            # (jit, score, device put) journal under it, so a slow
+            # generation decomposes per genome across the process gap
+            trace.to_wire(job, trace.mint())
             return job
 
         with ThreadPoolExecutor(self.workers) as pool:
@@ -446,6 +450,10 @@ class ChipEvaluatorPool(Logger):
                "seed": self.seed}
         if "gen" in jobs[0]:
             job["gen"] = jobs[0]["gen"]
+        # the cohort rides under the FIRST member's trace root (one
+        # dispatch, one trace) — the per-member contexts minted at
+        # prep are otherwise dropped with the per-genome jobs
+        trace.to_wire(job, trace.from_wire(jobs[0]))
         timeout = self.timeout * max(1, len(values_list))
         for attempt in (1, 2):
             try:

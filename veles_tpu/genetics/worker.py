@@ -331,7 +331,7 @@ def serve(args) -> int:
     import os
     import threading
 
-    from veles_tpu import events, faults, telemetry
+    from veles_tpu import events, faults, telemetry, trace
     from veles_tpu.analysis import witness
     from veles_tpu.backends import make_device
     from veles_tpu.config import root
@@ -396,11 +396,18 @@ def serve(args) -> int:
             fault_ctx["gen"] = job["gen"]
         seq += 1
         telemetry.counter(events.CTR_EVALUATOR_JOBS).inc()
+        # the pool's per-job trace root off the wire: running the job
+        # under our own child span makes every journaled event inside
+        # (the job span below included) auto-carry trace/span, so the
+        # parent-side merge decomposes a slow generation per genome
+        wctx = trace.from_wire(job)
         try:
             # the span is the child-side per-job record: its histogram
             # (evaluator.job_seconds) and journal line ride the
             # snapshot the parent pool merges after this process dies
-            with telemetry.span(events.SPAN_EVALUATOR_JOB_SECONDS,
+            with trace.use(wctx.child() if wctx is not None
+                           else None), \
+                 telemetry.span(events.SPAN_EVALUATOR_JOB_SECONDS,
                                 journal=True,
                                 job=job["id"],
                                 cohort=len(job.get("members", []))

@@ -303,6 +303,23 @@ PLOTS_DIR = _knob(
     "VELES_PLOTS_DIR", "plots", str,
     "Output directory of the graphics server's rendered plot "
     "artifacts.")
+TRACE_SAMPLE = _knob(
+    "VELES_TRACE_SAMPLE", 1.0, float,
+    "Flightline head-based trace sampling rate in [0, 1]: the "
+    "fraction of fleet requests minted with the sampled bit set "
+    "(error diffusion, so the rate is exact, not a coin flip).  A "
+    "sampled request carries trace/span/parent wire keys on every "
+    "hop and journals trace.* events for cross-process assembly; 0 "
+    "disables causal tracing (the bench trace phase's overhead "
+    "baseline).")
+FLIGHTREC_CAP = _knob(
+    "VELES_FLIGHTREC_CAP", 512, int,
+    "Entries the per-process flight-recorder ring retains (recent "
+    "spans/events, in memory, always armed).  The ring dumps to "
+    "flightrec-<pid>-<n>-<reason>.json in the metrics dir on "
+    "SIGTERM, injected SIGKILL, sentinel ejection, and promotion-"
+    "gate verdicts, so every ejection/rollback ships with the trace "
+    "tail that explains it.")
 
 # -- mesh execution (Lattice) ------------------------------------------
 

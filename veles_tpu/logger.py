@@ -77,6 +77,26 @@ def add_jsonl_sink(path: str) -> Callable[[], None]:
     return detach
 
 
+def _journal_hook(record: logging.LogRecord) -> None:
+    """Route WARNING+ log records into the Sightline journal (and the
+    Flightline ring), so the operator timeline interleaves log lines
+    with telemetry events instead of living in a second file.  Lazy
+    imports keep logger.py import-light; any failure drops the record
+    from the journal only — the console handler already ran."""
+    if record.levelno < logging.WARNING:
+        return
+    try:
+        from veles_tpu import events as _ev
+        from veles_tpu import telemetry as _tm
+        from veles_tpu import trace as _tr
+        _tm.event(_ev.EV_LOG_RECORD, level=record.levelname,
+                  unit=record.name, message=record.getMessage())
+        _tr.record("log." + record.levelname.lower(),
+                   unit=record.name, message=record.getMessage())
+    except Exception:  # noqa: BLE001 — observability must never
+        pass           # take down the unit that logged
+
+
 _configured = False
 
 
@@ -92,6 +112,19 @@ def setup_logging(level: int = logging.INFO) -> None:
         rootlog.addHandler(handler)
         rootlog.addHandler(_HookHandler())
         rootlog.propagate = False
+        if _journal_hook not in event_hooks:
+            event_hooks.append(_journal_hook)
+        # the veles_tpu.* namespace (faults.py logs there) previously
+        # reached stderr only via logging.lastResort; give it the same
+        # hook seam PLUS an explicit WARNING stderr handler so adding
+        # the journal route does not change what the console shows
+        # (propagate stays True: pytest caplog and operator root
+        # configs keep seeing these records)
+        flog = logging.getLogger("veles_tpu")
+        ferr = logging.StreamHandler(sys.stderr)
+        ferr.setLevel(logging.WARNING)
+        flog.addHandler(ferr)
+        flog.addHandler(_HookHandler())
         _configured = True
     rootlog.setLevel(level)
 

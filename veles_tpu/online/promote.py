@@ -30,9 +30,9 @@ swap time through the engine's next-dispatch hook.
 from __future__ import annotations
 
 import time
-from typing import Any
+from typing import Any, List, Optional
 
-from veles_tpu import events, faults, telemetry
+from veles_tpu import events, faults, telemetry, trace
 
 #: gate lifecycle states (numeric code = list index, for the
 #: ``online.model.<name>.gate_state`` gauge family)
@@ -89,11 +89,15 @@ class PromotionGate:
                         margin=self.margin, verdict=verdict)
         return verdict
 
-    def promote(self, stacked_params: Any, steps: int) -> None:
+    def promote(self, stacked_params: Any, steps: int,
+                lineage: Optional[List[str]] = None) -> None:
         """Hand the shadow's device-resident params to the serving
         engine.  The ``online.swap_mid_request`` stall fires BEFORE
         the residency lock (a drill must widen the race window, not
-        create a blocking-under-lock hazard)."""
+        create a blocking-under-lock hazard).  ``lineage`` (the tap's
+        recent trace-id tail) journals WITH the promotion — the
+        Evergreen chain stays causally traceable from a served
+        promotion back to the live traffic that trained it."""
         f = faults.fire("online.swap_mid_request", model=self.model)
         if f:
             time.sleep(float(f.get("seconds", 0.25)))
@@ -119,9 +123,14 @@ class PromotionGate:
             events.EV_ONLINE_PROMOTED, model=self.model, steps=steps,
             shadow_error_pct=self.shadow_error_pct,
             incumbent_error_pct=self.incumbent_error_pct,
-            swap_ms=round(swap_ms, 3))
+            swap_ms=round(swap_ms, 3),
+            lineage=list(lineage) if lineage else None)
+        trace.record("gate.promote", model=self.model, steps=steps,
+                     lineage_n=len(lineage) if lineage else 0)
+        trace.dump("promote")
 
-    def rollback(self, steps: int) -> None:
+    def rollback(self, steps: int,
+                 lineage: Optional[List[str]] = None) -> None:
         self.rollbacks += 1
         self.state = "rolled_back"
         self.cooldown_until_step = steps + 4 * self.min_steps
@@ -129,7 +138,11 @@ class PromotionGate:
         telemetry.event(
             events.EV_ONLINE_ROLLBACK, model=self.model, steps=steps,
             shadow_error_pct=self.shadow_error_pct,
-            incumbent_error_pct=self.incumbent_error_pct)
+            incumbent_error_pct=self.incumbent_error_pct,
+            lineage=list(lineage) if lineage else None)
+        trace.record("gate.rollback", model=self.model, steps=steps,
+                     lineage_n=len(lineage) if lineage else 0)
+        trace.dump("rollback")
 
     def state_code(self) -> int:
         try:

@@ -26,17 +26,22 @@ held-out slice refuses to promote garbage.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu import events, faults, telemetry
+from veles_tpu import events, faults, telemetry, trace
 from veles_tpu.analysis import witness
 from veles_tpu.online.buffer import ReplayBuffer
 
 #: tapped-but-unlabeled requests a model may hold before the oldest
 #: pending row is evicted (late labels for it then count as orphans)
 PENDING_CAP = 256
+
+#: contributing trace ids retained per model — the promotion gate's
+#: lineage sample (a bounded tail, not the full training history)
+LINEAGE_CAP = 32
 
 
 class TrafficTap:
@@ -51,6 +56,10 @@ class TrafficTap:
         self._pending: "Dict[Any, Tuple[str, np.ndarray]]" = {}
         #: model name -> ReplayBuffer (armed by the learner)
         self.buffers: Dict[str, ReplayBuffer] = {}
+        #: model name -> recent trace ids of tapped traffic — the
+        #: Flightline lineage a promotion carries back to the live
+        #: requests that trained it
+        self._lineage: Dict[str, "deque[str]"] = {}
 
     def arm(self, model: str, buffer: ReplayBuffer) -> None:
         with self._lock:
@@ -60,10 +69,13 @@ class TrafficTap:
     # -- the admission-path hook ---------------------------------------
 
     def tap(self, model: str, jid: Any, rows: np.ndarray,
-            label: Optional[Any] = None) -> None:
+            label: Optional[Any] = None,
+            ctx: Optional[trace.TraceContext] = None) -> None:
         """One admitted request.  Samples deterministically; labeled
         rows go straight to the buffer, unlabeled ones park for a
-        ``label_of`` join."""
+        ``label_of`` join.  ``ctx`` (the request's Flightline span)
+        enters the model's bounded lineage tail so the NEXT promotion
+        can name the traffic that trained it."""
         with self._lock:
             buf = self.buffers.get(model)
             if buf is None or self.frac <= 0.0:
@@ -73,6 +85,10 @@ class TrafficTap:
             if take:
                 acc -= 1.0
             self._acc[model] = acc
+            if take and ctx is not None and ctx.sampled:
+                self._lineage.setdefault(
+                    model, deque(maxlen=LINEAGE_CAP)).append(
+                    ctx.trace_id)
         if not take:
             return
         rows = np.asarray(rows, np.float32)
@@ -127,3 +143,10 @@ class TrafficTap:
     def pending_count(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    def lineage_sample(self, model: str) -> List[str]:
+        """Recent trace ids of ``model``'s tapped traffic, oldest
+        first (empty when tracing is off) — stamped onto promotion /
+        rollback journal entries."""
+        with self._lock:
+            return list(self._lineage.get(model, ()))

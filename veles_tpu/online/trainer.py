@@ -494,14 +494,18 @@ class OnlineLearner(Logger):
         # the round's cost counts against the duty budget too
         verdict = gate.decide(trainer.steps, shadow_err,
                               incumbent_err)
+        # the tap's recent trace-id tail: the verdict journals WITH
+        # the lineage of the traffic that produced these params
+        lineage = self.tap.lineage_sample(name)
         if verdict == "promote":
-            gate.promote(trainer.take_params(), trainer.steps)
+            gate.promote(trainer.take_params(), trainer.steps,
+                         lineage=lineage)
             # host spill/restore copies refresh OFF the swap path
             self.residency.refresh_host_params(
                 name, trainer.host_members())
         elif verdict == "rollback":
             trainer.reset_from(engine.stacked_params)
-            gate.rollback(trainer.steps)
+            gate.rollback(trainer.steps, lineage=lineage)
         # the whole round — scoring, tree copies, the host param
         # refresh — is scavenged work; it all pays duty rest
         self._rest_until = time.monotonic() + \

@@ -1185,18 +1185,22 @@ class EnsembleEvalEngine:
                                      sample_shape=sample_shape)
         return self._batcher
 
-    def submit(self, rows: np.ndarray, deadline_ms=None):
+    def submit(self, rows: np.ndarray, deadline_ms=None, ctx=None):
         """Request-level inference: enqueue ``rows`` (one request of
         one or more samples) and return a ``concurrent.futures.Future``
         resolving to the mean member probabilities for exactly those
         rows.  The micro-batching loop coalesces concurrent requests —
         this is the serving tier's whole-dataset-free entry point.
         ``deadline_ms`` (absolute unix-epoch ms) lets the batcher drop
-        the request unanswered once nobody is waiting for it."""
+        the request unanswered once nobody is waiting for it; ``ctx``
+        (a Flightline :class:`~veles_tpu.trace.TraceContext`) rides
+        through so the batcher can attribute queue wait vs device
+        dispatch to the request's trace."""
         if self._batcher is None:
             raise RuntimeError("attach_batcher() first — submit() is "
                                "the micro-batched serving API")
-        return self._batcher.submit(rows, deadline_ms=deadline_ms)
+        return self._batcher.submit(rows, deadline_ms=deadline_ms,
+                                    ctx=ctx)
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Block until every submitted request has resolved (the

@@ -33,7 +33,7 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu import events, faults, telemetry
+from veles_tpu import events, faults, telemetry, trace
 from veles_tpu.analysis import witness
 from veles_tpu.ops import batching
 
@@ -51,16 +51,22 @@ class _Pending:
     """One submitted request: its rows, result slots, and Future."""
 
     __slots__ = ("rows", "future", "t0", "results", "taken", "popped",
-                 "deadline_ms")
+                 "deadline_ms", "ctx", "wait_s")
 
     def __init__(self, rows: np.ndarray,
-                 deadline_ms: Optional[float] = None) -> None:
+                 deadline_ms: Optional[float] = None,
+                 ctx: Optional[trace.TraceContext] = None) -> None:
         self.rows = rows
         self.future: Future = Future()
         self.t0 = time.perf_counter()
         #: absolute unix-epoch milliseconds (the wire clock shared
         #: with the router); None = no deadline
         self.deadline_ms = deadline_ms
+        #: Flightline span of this request (None when untraced)
+        self.ctx = ctx
+        #: queue wait of the first slice (the coalescing-window cost,
+        #: split out on the request's trace.serve journal entry)
+        self.wait_s: Optional[float] = None
         #: result slices in submission order (multi-dispatch requests)
         self.results: List[np.ndarray] = []
         #: rows already handed to a dispatch
@@ -110,7 +116,8 @@ class MicroBatcher:
     # -- producer side -------------------------------------------------
 
     def submit(self, rows: Any,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               ctx: Optional[trace.TraceContext] = None) -> Future:
         """Enqueue one request of ``rows`` (one or more samples);
         returns a Future resolving to the per-row outputs in request
         order.  Thread-safe; never blocks on the device.
@@ -118,12 +125,17 @@ class MicroBatcher:
         ``deadline_ms`` (absolute unix-epoch milliseconds) marks when
         the caller stops waiting: a request still fully queued past it
         is dropped with :class:`DeadlineExpired` instead of
-        dispatched."""
+        dispatched.  ``ctx`` (a sampled Flightline span) makes the
+        request's coalescing first-class: its ``trace.serve`` journal
+        entry splits queue wait from device dispatch, and the batch
+        that carried it LINKS its span (``trace.batch``)."""
         rows = np.asarray(rows, np.float32)
         if rows.ndim == 0 or len(rows) == 0:
             raise ValueError("a request needs at least one sample row")
         p = _Pending(rows, deadline_ms=float(deadline_ms)
-                     if deadline_ms is not None else None)
+                     if deadline_ms is not None else None,
+                     ctx=ctx if ctx is not None and ctx.sampled
+                     else None)
         with self._cond:
             if self._closed:
                 raise RuntimeError(f"batcher {self.label!r} is closed")
@@ -263,9 +275,11 @@ class MicroBatcher:
             t_wait = time.perf_counter()
             for p, s, n in take:
                 if s == 0:
+                    p.wait_s = t_wait - p.t0
                     telemetry.histogram(
                         events.HIST_SERVE_WAIT_SECONDS).record(
-                        t_wait - p.t0)
+                        t_wait - p.t0,
+                        exemplar=p.ctx.trace_id if p.ctx else None)
             f = faults.fire("hive.slow_dispatch", label=self.label)
             if f:
                 # Faultline gray-failure rehearsal: the dispatch
@@ -280,15 +294,33 @@ class MicroBatcher:
                     events.CTR_SERVE_REQUEST_ERRORS).inc(len(take))
                 self._resolve(take, None, err=e)
                 continue
+            dispatch_s = time.perf_counter() - t_wait
+            links = [{"trace": p.ctx.trace_id, "span": p.ctx.span_id}
+                     for p, s, n in take if p.ctx is not None and s == 0]
+            if len(links) >= 2:
+                # one batch-dispatch span LINKS its member request
+                # spans — coalescing is a fan-in, not a parent/child.
+                # A singleton batch coalesced nothing: its serve event
+                # already carries the dispatch, so skip the link event
+                # (per-request journal writes are the tracing overhead
+                # the bench gate bounds)
+                telemetry.event(events.EV_TRACE_BATCH,
+                                span=trace.new_span_id(),
+                                label=self.label, rows=n_valid,
+                                dispatch_s=round(dispatch_s, 6),
+                                links=links)
+                trace.record("serve.batch", label=self.label,
+                             rows=n_valid,
+                             dispatch_s=round(dispatch_s, 6))
             telemetry.counter(events.CTR_SERVE_BATCHES).inc()
             telemetry.counter(events.CTR_SERVE_ROWS).inc(n_valid)
             telemetry.counter(events.CTR_SERVE_BATCH_SLOTS).inc(
                 self.max_batch)
             telemetry.histogram(events.HIST_SERVE_BATCH_ROWS).record(
                 n_valid)
-            self._resolve(take, np.asarray(out))
+            self._resolve(take, np.asarray(out), dispatch_s=dispatch_s)
 
-    def _resolve(self, take, out, err=None) -> None:
+    def _resolve(self, take, out, err=None, dispatch_s=None) -> None:
         off = 0
         done: List[_Pending] = []
         for p, s, n in take:
@@ -307,11 +339,29 @@ class MicroBatcher:
                 done.append(p)
         now = time.perf_counter()
         self.last_activity = time.monotonic()
+        for p in done:
+            if p.ctx is not None:
+                # the request's serve-side span: queue wait vs device
+                # dispatch split — the two halves the critical-path
+                # renderer attributes (trace.serve)
+                telemetry.event(
+                    events.EV_TRACE_SERVE,
+                    trace=p.ctx.trace_id, span=p.ctx.span_id,
+                    parent=p.ctx.parent_id, label=self.label,
+                    rows=int(len(p.rows)),
+                    wait_s=round(p.wait_s, 6)
+                    if p.wait_s is not None else None,
+                    dispatch_s=round(dispatch_s, 6)
+                    if dispatch_s is not None else None,
+                    total_s=round(now - p.t0, 6),
+                    error=type(err).__name__ if err is not None
+                    else None)
         with self._cond:
             for p in done:
                 telemetry.histogram(
                     events.HIST_SERVE_REQUEST_SECONDS).record(
-                    now - p.t0)
+                    now - p.t0,
+                    exemplar=p.ctx.trace_id if p.ctx else None)
                 if p.popped:
                     self._inflight -= 1
                 elif self._queue and self._queue[0] is p:

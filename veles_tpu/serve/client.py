@@ -28,7 +28,7 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-from veles_tpu import events, telemetry
+from veles_tpu import events, telemetry, trace
 from veles_tpu.analysis import witness
 
 
@@ -232,14 +232,17 @@ class HiveClient:
 
     def submit(self, model: str, rows: Any,
                deadline_ms: Optional[float] = None,
-               label: Optional[Any] = None) -> int:
+               label: Optional[Any] = None,
+               ctx: Optional[trace.TraceContext] = None) -> int:
         """Fire one request without waiting; returns its wire id
         (collect with :meth:`wait_for` or :meth:`collect_async`).
         ``deadline_ms`` (absolute unix-epoch milliseconds) rides the
         wire: the hive batcher drops the request unanswered once it
         expires instead of computing for an absent waiter.
         ``label`` (per-row ground truth) feeds an ``--online`` hive's
-        learning tap."""
+        learning tap.  ``ctx`` (a sampled Flightline span — usually
+        the router's per-leg child) stamps the trace-propagation wire
+        fields so the hive's spans join the caller's trace."""
         jid = self._draw_id()
         msg = {"id": jid, "model": model,
                "rows": np.asarray(rows, np.float32).tolist()}
@@ -247,6 +250,7 @@ class HiveClient:
             msg["deadline_ms"] = float(deadline_ms)
         if label is not None:
             msg["label"] = np.asarray(label).tolist()
+        trace.to_wire(msg, ctx)
         self._send(msg)
         return jid
 

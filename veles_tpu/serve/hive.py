@@ -68,7 +68,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from veles_tpu import events, faults, knobs, telemetry
+from veles_tpu import events, faults, knobs, telemetry, trace
 from veles_tpu.analysis import witness
 from veles_tpu.serve.batcher import DeadlineExpired
 from veles_tpu.supervisor import EXIT_PREEMPTED
@@ -356,11 +356,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             # wedged batcher while heartbeats/stats keep flowing — the
             # caller's deadline (never this process) must catch it
             return True
+        # the router's trace context off the wire; this process's own
+        # admission span parents under the sending leg, and the
+        # batcher attributes queue wait vs dispatch to it
+        wctx = trace.from_wire(job)
+        sctx = wctx.child() if wctx is not None else None
         try:
-            model = job["model"]
-            rows = np.asarray(job["rows"], np.float32)
-            engine = residency.ensure(model)
-            fut = engine.submit(rows, deadline_ms=job.get("deadline_ms"))
+            with trace.use(sctx):
+                model = job["model"]
+                rows = np.asarray(job["rows"], np.float32)
+                engine = residency.ensure(model)
+                fut = engine.submit(rows,
+                                    deadline_ms=job.get("deadline_ms"),
+                                    ctx=sctx)
         except (KeyboardInterrupt, SystemExit):
             raise
         except BaseException as e:  # noqa: BLE001 — a bad request
@@ -368,10 +376,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             telemetry.counter(events.CTR_SERVE_REQUEST_ERRORS).inc()
             emit({"id": jid, "error": f"{type(e).__name__}: {e}"})
             return True
+        if sctx is not None and sctx.sampled:
+            trace.record("hive.request", ctx=sctx, model=model,
+                         rows=int(len(rows)))
         if learner is not None:
             # tapped AFTER admission: only rows the engine accepted
             # (shape-checked, submitted) may enter the replay buffer
-            learner.tap.tap(model, jid, rows, job.get("label"))
+            learner.tap.tap(model, jid, rows, job.get("label"),
+                            ctx=sctx)
 
         def _deliver(f, jid=jid, model=model) -> None:
             try:
@@ -442,6 +454,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError:
             reason = f"sig{stop['signal']}"
         rc = EXIT_PREEMPTED
+        # the flight recorder's SIGTERM hook: the ring + journal tail
+        # land on disk even if the final flush below never completes
+        trace.dump("sigterm")
     telemetry.event(events.EV_SERVE_SHUTDOWN, reason=reason, code=rc)
     hb_stop.set()
     telemetry.flush()

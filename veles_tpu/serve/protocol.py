@@ -83,6 +83,17 @@ K_MAX_INFLIGHT = _k("max_inflight")
 
 K_ONLINE = _k("online")          #: hello: the learning tier is armed
 
+# -- trace context (Flightline) ----------------------------------------
+# minted at the Swarm router, propagated on EVERY wire hop (requests,
+# hedge copies, failover retries, GA cohort jobs); veleslint's
+# trace-wire-key rule pins veles_tpu/trace.py's WIRE_FIELDS to this
+# registry so a propagation key can never ship undeclared
+
+K_TRACE = _k("trace")            #: 16-hex trace id (one request tree)
+K_SPAN = _k("span")              #: 8-hex span id of the sending hop
+K_PARENT = _k("parent")          #: span id of the causing hop
+K_SAMPLED = _k("sampled")        #: head-based sampling bit
+
 # -- heartbeats --------------------------------------------------------
 
 K_HB = _k("hb")                  #: heartbeat sequence number

@@ -63,7 +63,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu import events, knobs, telemetry
+from veles_tpu import events, knobs, telemetry, trace
 from veles_tpu.analysis import witness
 from veles_tpu.logger import Logger
 from veles_tpu.serve.client import ReplicaDied
@@ -264,21 +264,40 @@ class FleetRouter(Logger):
             if timeout:
                 budget = min(budget, 1000.0 * timeout)
             deadline_ms = time.time() * 1000.0 + budget
+        # the trace ROOT is minted here, at the fleet's admission
+        # edge: every leg (hedge copies, failover retries, canary
+        # mirrors) derives a child span from it, so one request
+        # assembles into ONE cross-process tree however it was routed
+        ctx = trace.mint()
         t0 = time.perf_counter()
-        resp = self._dispatch(model, rows, float(deadline_ms))
-        if resp.get("overloaded"):
-            telemetry.counter(events.CTR_FLEET_SHED).inc()
-            telemetry.counter(f"fleet.model.{model}.shed").inc()
-        elif "error" in resp:
-            telemetry.counter(events.CTR_FLEET_REQUEST_ERRORS).inc()
-            telemetry.counter(f"fleet.model.{model}.errors").inc()
-        else:
-            dt = time.perf_counter() - t0
-            telemetry.histogram(
-                events.HIST_FLEET_REQUEST_SECONDS).record(dt)
-            telemetry.histogram(
-                f"fleet.model.{model}.request_seconds").record(dt)
-            self._maybe_mirror(model, rows, timeout)
+        with trace.use(ctx):
+            resp = self._dispatch(model, rows, float(deadline_ms))
+            if resp.get("overloaded"):
+                telemetry.counter(events.CTR_FLEET_SHED).inc()
+                telemetry.counter(f"fleet.model.{model}.shed").inc()
+            elif "error" in resp:
+                telemetry.counter(
+                    events.CTR_FLEET_REQUEST_ERRORS).inc()
+                telemetry.counter(f"fleet.model.{model}.errors").inc()
+            else:
+                dt = time.perf_counter() - t0
+                telemetry.histogram(
+                    events.HIST_FLEET_REQUEST_SECONDS).record(
+                    dt, exemplar=ctx.trace_id if ctx.sampled else None)
+                telemetry.histogram(
+                    f"fleet.model.{model}.request_seconds").record(dt)
+                self._maybe_mirror(model, rows, timeout)
+            if ctx.sampled:
+                outcome = ("shed" if resp.get("overloaded")
+                           else "timeout" if resp.get("timeout")
+                           else "error" if "error" in resp else "ok")
+                telemetry.event(
+                    events.EV_TRACE_REQUEST, trace=ctx.trace_id,
+                    span=ctx.span_id, model=model,
+                    rows=int(len(rows)), outcome=outcome,
+                    seconds=round(time.perf_counter() - t0, 6))
+                trace.record("fleet.request", ctx=ctx, model=model,
+                             outcome=outcome)
         return resp
 
     def _dispatch(self, model: str, rows: Any,
@@ -334,6 +353,29 @@ class FleetRouter(Logger):
         actually hedges."""
         n_rows = int(len(rows))
         t_start = time.perf_counter()
+        # the round runs on the thread that minted the root (or a pool
+        # worker under ``trace.use``); each LEG — primary attempt,
+        # hedge copy, failover retry — gets its own child span, so the
+        # assembled trace shows every replica the request touched
+        rctx = trace.current()
+
+        def leg_ctx() -> Optional[trace.TraceContext]:
+            return rctx.child() \
+                if rctx is not None and rctx.sampled else None
+
+        def leg_event(rep: Replica,
+                      lctx: Optional[trace.TraceContext],
+                      verdict: str, t0_leg: float,
+                      hedge: bool = False,
+                      winner: bool = False) -> None:
+            if lctx is None:
+                return
+            telemetry.event(
+                events.EV_TRACE_LEG, trace=lctx.trace_id,
+                span=lctx.span_id, parent=lctx.parent_id,
+                replica=rep.idx, verdict=verdict,
+                seconds=round(time.perf_counter() - t0_leg, 6),
+                hedge=bool(hedge), winner=bool(winner))
 
         def timeout_resp() -> Tuple[Dict[str, Any], str]:
             return ({"error": "deadline exceeded", "model": model,
@@ -368,13 +410,17 @@ class FleetRouter(Logger):
         primary.acquire()
         telemetry.gauge(events.GAUGE_FLEET_INFLIGHT).set(
             self.inflight_total())
+        pctx = leg_ctx()
+        t_leg0 = time.perf_counter()
         try:
             jid = primary.client.submit(model, rows,
-                                        deadline_ms=deadline_ms)
+                                        deadline_ms=deadline_ms,
+                                        ctx=pctx)
         except ReplicaDied:
             primary.release()
             primary.mark_dead()
             self.sentinel.record_died(primary)
+            leg_event(primary, pctx, "died", t_leg0)
             return {"error": "replica died", "model": model}, "died"
         # -- phase 1: plain wait until the hedge threshold ------------
         hedge_thr_s = self.sentinel.hedge_threshold_ms(model) / 1000.0
@@ -382,7 +428,10 @@ class FleetRouter(Logger):
             msg = primary.client.wait_for(
                 jid, timeout=max(0.001, min(hedge_thr_s, remain_s)))
             primary.release()
-            return evaluate(primary, msg)
+            out = evaluate(primary, msg)
+            leg_event(primary, pctx, out[1], t_leg0,
+                      winner=out[1] == "ok")
+            return out
         except TimeoutError:
             pass   # outlived the hedge threshold: fall through
         except ReplicaDied:
@@ -393,6 +442,7 @@ class FleetRouter(Logger):
             primary.release()
             primary.mark_dead()
             self.sentinel.record_died(primary)
+            leg_event(primary, pctx, "died", t_leg0)
             return {"error": "replica died", "model": model}, "died"
         # -- the request outlived the hedge threshold -----------------
         remain_s = (deadline_ms - time.time() * 1000.0) / 1000.0
@@ -400,6 +450,7 @@ class FleetRouter(Logger):
             primary.client.cancel(jid)
             primary.release()
             self.sentinel.record_timeout(primary)
+            leg_event(primary, pctx, "timeout", t_leg0)
             return timeout_resp()
         peer: Optional[Replica] = None
         if self.sentinel.hedge_budget > 0:
@@ -422,40 +473,58 @@ class FleetRouter(Logger):
                 primary.client.cancel(jid)
                 primary.release()
                 self.sentinel.record_timeout(primary)
+                leg_event(primary, pctx, "timeout", t_leg0)
                 return timeout_resp()
             except ReplicaDied:
                 primary.client.cancel(jid)
                 primary.release()
                 primary.mark_dead()
                 self.sentinel.record_died(primary)
+                leg_event(primary, pctx, "died", t_leg0)
                 return ({"error": "replica died", "model": model},
                         "died")
             primary.release()
-            return evaluate(primary, msg)
+            out = evaluate(primary, msg)
+            leg_event(primary, pctx, out[1], t_leg0,
+                      winner=out[1] == "ok")
+            return out
         # -- phase 2: the hedged fan-in (the rare, already-slow case) -
         telemetry.counter(events.CTR_FLEET_HEDGES).inc()
+        trace.record("fleet.hedge", ctx=rctx, model=model,
+                     primary=primary.idx, peer=peer.idx)
         results: "queue.SimpleQueue[Tuple[Replica, int, Any, Any]]" \
             = queue.SimpleQueue()
         outstanding: Dict[Tuple[int, int], Replica] = {}
+        # per-leg trace span + submit time, keyed like ``outstanding``
+        # — BOTH hedge legs are recorded and the winner attributed
+        legmeta: Dict[Tuple[int, int],
+                      Tuple[Optional[trace.TraceContext],
+                            float, bool]] = {}
         outstanding[(primary.idx, jid)] = primary
+        legmeta[(primary.idx, jid)] = (pctx, t_leg0, False)
         primary.client.collect_async(
             jid, lambda m, e, rep=primary, j=jid:
             results.put((rep, j, m, e)))
         with self._lock:
             self._routed[peer.idx] += 1
         peer.acquire()
+        hctx = leg_ctx()
+        t_hleg0 = time.perf_counter()
         try:
             hjid = peer.client.submit(model, rows,
-                                      deadline_ms=deadline_ms)
+                                      deadline_ms=deadline_ms,
+                                      ctx=hctx)
         except ReplicaDied:
             peer.release()
             peer.mark_dead()
             self.sentinel.record_died(peer)
+            leg_event(peer, hctx, "died", t_hleg0, hedge=True)
         else:
             # registered ONLY after the submit succeeded: the except
             # arm above covers exactly the risky call, so a hedge id
             # can never be created and then forgotten
             outstanding[(peer.idx, hjid)] = peer
+            legmeta[(peer.idx, hjid)] = (hctx, t_hleg0, True)
             peer.client.collect_async(
                 hjid, lambda m, e, rep=peer, j=hjid:
                 results.put((rep, j, m, e)))
@@ -466,6 +535,11 @@ class FleetRouter(Logger):
                 rep.release()
                 if score_timeout:
                     self.sentinel.record_timeout(rep)
+                lctx, t0l, hedged = legmeta.get(
+                    (idx, ojid), (None, t_start, False))
+                leg_event(rep, lctx,
+                          "timeout" if score_timeout else "cancelled",
+                          t0l, hedge=hedged)
             outstanding.clear()
 
         fail: Optional[Tuple[Dict[str, Any], str]] = None
@@ -483,13 +557,18 @@ class FleetRouter(Logger):
                 continue   # already cancelled
             outstanding.pop((rep.idx, rjid))
             rep.release()
+            lctx, t0l, hedged = legmeta.get(
+                (rep.idx, rjid), (None, t_start, False))
             if err is not None:
                 rep.mark_dead()
                 self.sentinel.record_died(rep)
+                leg_event(rep, lctx, "died", t0l, hedge=hedged)
                 fail = ({"error": "replica died", "model": model},
                         "died")
                 continue   # the other leg may still answer
             out = evaluate(rep, msg)
+            leg_event(rep, lctx, out[1], t0l, hedge=hedged,
+                      winner=out[1] == "ok")
             if out[1] == "ok":
                 if rep is peer and "probs" in out[0]:
                     self.sentinel.record_hedge_win(rep, primary)
@@ -578,13 +657,19 @@ class FleetRouter(Logger):
             telemetry.counter(f"fleet.model.{cname}.requests").inc()
             telemetry.counter(f"fleet.model.{cname}.mirrored").inc()
             t0 = time.perf_counter()
+            # the mirror leg joins the primary request's trace (a
+            # child of the root minted in request()) so a canary
+            # regression can be tied back to the traffic that hit it
+            c = trace.current()
+            mctx = c.child() if c is not None and c.sampled else None
             r.acquire()
             try:
                 jid = r.client.submit(
                     cname, rows,
                     deadline_ms=time.time() * 1000.0
                     + self.deadline_ms if self.deadline_ms > 0
-                    else None)
+                    else None,
+                    ctx=mctx)
             except ReplicaDied:
                 r.release()
                 r.mark_dead()
@@ -945,6 +1030,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError:
             reason = f"sig{stop['signal']}"
         rc = EXIT_PREEMPTED
+        # flight-recorder SIGTERM hook: the router's recent legs and
+        # hedges reach disk before the replicas are torn down
+        trace.dump("sigterm")
     router.close(reason=reason, code=rc)
     hb_stop.set()
     telemetry.flush()

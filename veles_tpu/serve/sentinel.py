@@ -46,7 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu import events, knobs, telemetry
+from veles_tpu import events, knobs, telemetry, trace
 from veles_tpu.analysis import witness
 from veles_tpu.logger import Logger
 
@@ -352,6 +352,12 @@ class Sentinel(Logger):
                 events.EV_FLEET_REPLICA_EJECTED, replica=replica.idx,
                 state=STATE_EJECTED, score=round(score, 3),
                 strikes=strikes)
+            # an ejection is exactly when an operator asks "what was
+            # the fleet doing?" — dump the flight recorder's ring
+            # (recent legs, hedges, strikes) alongside the event
+            trace.record("sentinel.eject", replica=replica.idx,
+                         score=round(score, 3))
+            trace.dump("ejection")
             self.warning(
                 "replica %d EJECTED from routing (health score %.2f "
                 ">= %.2f; strikes %s) — probing on backoff",

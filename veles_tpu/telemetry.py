@@ -149,7 +149,7 @@ class Histogram:
     """
 
     __slots__ = ("name", "count", "sum", "min", "max", "buckets",
-                 "_lock")
+                 "exemplars", "_lock")
 
     def __init__(self, name: str) -> None:
         self.name = name
@@ -158,6 +158,9 @@ class Histogram:
         self.min = math.inf
         self.max = -math.inf
         self.buckets: List[int] = [0] * (NBUCKETS + 2)
+        #: bucket index -> last trace_id that landed there (tail
+        #: exemplars: "why is p99 high" jumps straight to a trace)
+        self.exemplars: Dict[int, str] = {}
         self._lock = witness.lock("telemetry.histogram")
 
     @staticmethod
@@ -169,7 +172,11 @@ class Histogram:
         i = int((math.log10(x) - LOG_LO) * PER_DECADE)
         return 1 + min(NBUCKETS - 1, max(0, i))
 
-    def record(self, x: float) -> None:
+    def record(self, x: float,
+               exemplar: Optional[str] = None) -> None:
+        """Record one sample; ``exemplar`` (a trace_id) is retained
+        per landing bucket, last-wins, so the tail buckets always
+        name a renderable trace (Flightline's p99 exemplars)."""
         if not _enabled:
             return
         x = float(x)
@@ -180,7 +187,10 @@ class Histogram:
                 self.min = x
             if x > self.max:
                 self.max = x
-            self.buckets[self._index(x)] += 1
+            i = self._index(x)
+            self.buckets[i] += 1
+            if exemplar is not None:
+                self.exemplars[i] = exemplar
 
     @property
     def mean(self) -> Optional[float]:
@@ -255,6 +265,9 @@ class Histogram:
                 "buckets": {str(i): c
                             for i, c in enumerate(self.buckets) if c},
             }
+            if self.exemplars:
+                d["exemplars"] = {str(i): t
+                                  for i, t in self.exemplars.items()}
         for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
             d[key] = self.quantile(q)
         return d
@@ -271,6 +284,8 @@ class Histogram:
                 self.max = max(self.max, float(d["max"]))
             for i, c in (d.get("buckets") or {}).items():
                 self.buckets[int(i)] += int(c)
+            for i, t in (d.get("exemplars") or {}).items():
+                self.exemplars.setdefault(int(i), str(t))
 
     def _reset(self) -> None:
         with self._lock:
@@ -279,6 +294,7 @@ class Histogram:
             self.min = math.inf
             self.max = -math.inf
             self.buckets = [0] * (NBUCKETS + 2)
+            self.exemplars = {}
 
 
 class Registry:
@@ -414,11 +430,23 @@ def _journal(rec: Dict[str, Any]) -> None:
                 _journal_file = open(
                     os.path.join(_dir,
                                  f"journal-{os.getpid()}.jsonl"),
-                    "a", buffering=1)
+                    "a", buffering=1 << 16)
             except OSError:
                 return
         try:
             _journal_file.write(json.dumps(rec) + "\n")
+            # notable state transitions hit the disk IMMEDIATELY (the
+            # pre-Flightline per-line behavior: a concurrent reader —
+            # chaos drills poll live journals — sees them at once).
+            # Only the per-request ``trace.*`` family rides the file
+            # buffer: a write() syscall per hop is exactly the serving
+            # overhead the tracing gate bounds, its readers are
+            # offline assemblers, and the crash tail lives in the
+            # flight-recorder ring anyway.  The buffer drains on the
+            # next notable event, the periodic background flush, or
+            # shutdown.
+            if not str(rec.get("event", "")).startswith("trace."):
+                _journal_file.flush()
         except (OSError, ValueError):
             # full/vanished disk or closed handle: observability must
             # never take down the run — drop the sink, keep the ring
@@ -429,14 +457,40 @@ def _journal(rec: Dict[str, Any]) -> None:
             _journal_file = None
 
 
+#: the Flightline seam: a zero-arg callable returning (trace_id,
+#: span_id) when a sampled trace context is parked on this thread,
+#: else None — veles_tpu/trace.py registers it at import so every
+#: journal event inside ``trace.use(ctx)`` auto-carries its trace
+_trace_provider: Optional[Any] = None
+
+
+def set_trace_provider(fn: Optional[Any]) -> None:
+    global _trace_provider
+    _trace_provider = fn
+
+
 def event(name: str, **fields: Any) -> None:
     """Append one journal event (and keep it in the in-memory ring).
     Events are for notable state transitions, not per-dispatch data —
-    histograms carry the hot-path distributions."""
+    histograms carry the hot-path distributions.  Every event carries
+    a ``mono`` monotonic stamp next to ``ts`` so cross-process merges
+    can skew-correct the interleaving (obs.py), plus ``trace``/
+    ``span`` when the thread runs under a sampled trace context
+    (explicit caller fields win over the provider's)."""
     if not _enabled:
         return
-    rec: Dict[str, Any] = {"ts": round(time.time(), 3), "event": name}
+    rec: Dict[str, Any] = {"ts": round(time.time(), 3),
+                           "mono": round(time.monotonic(), 6),
+                           "event": name}
     rec.update(fields)
+    if _trace_provider is not None:
+        try:
+            t = _trace_provider()
+        except Exception:  # noqa: BLE001 — tracing must never break
+            t = None       # the journal
+        if t is not None:
+            rec.setdefault("trace", t[0])
+            rec.setdefault("span", t[1])
     _recent.append(rec)
     _journal(rec)
     _maybe_flush()
@@ -527,10 +581,22 @@ def flush() -> Optional[str]:
 
 
 def _maybe_flush() -> None:
+    # the throttled flush runs OFF the emitting thread: a full
+    # snapshot write costs 1-3ms, and paying it synchronously inside
+    # event() put a once-per-FLUSH_EVERY stall squarely into the
+    # serving p99 whenever tracing (or any per-request journaling)
+    # was on — the Flightline overhead gate caught it
     global _last_flush
     if _dir and time.monotonic() - _last_flush > FLUSH_EVERY:
         _last_flush = time.monotonic()   # even on failure: no storms
-        flush()
+
+        def _bg() -> None:
+            try:
+                flush()
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
+        threading.Thread(target=_bg, daemon=True,
+                         name="telemetry-flush").start()
 
 
 def maybe_flush() -> None:

@@ -1,0 +1,264 @@
+"""Flightline: fleet-wide causal tracing + the crash-proof flight
+recorder.
+
+Sightline (veles_tpu/telemetry.py) answers "how fast" per process;
+this module answers "what happened to THIS request" after Swarm fans
+it across replicas, Sentinel hedges it, the batcher coalesces it, and
+Evergreen's tap feeds it into a replay buffer.  Two pieces:
+
+- **TraceContext** — ``(trace_id, span_id, parent_id, sampled)``,
+  minted ONCE at the Swarm router's admission edge (``mint()``,
+  head-based sampling at ``$VELES_TRACE_SAMPLE`` via the tap.py
+  error-diffusion idiom, so the sampled fraction is exact) and
+  propagated on every wire hop through the ``trace``/``span``/
+  ``parent``/``sampled`` JSONL fields (registered in
+  serve/protocol.py; veleslint's trace-wire-key rule pins
+  :data:`WIRE_FIELDS` to that registry).  Each hop derives its own
+  span with :meth:`TraceContext.child`; ``use(ctx)`` parks the
+  context thread-locally so every journaled telemetry event inside
+  the block auto-carries ``trace``/``span`` (telemetry's trace
+  provider seam) — cross-process assembly then merges the
+  ``journal-*.jsonl`` files by trace_id (``veles_tpu/obs.py``).
+
+- **The flight recorder** — a fixed-size in-memory ring of recent
+  spans/events (``record()``, no I/O on the hot path, ALWAYS armed),
+  dumped to ``flightrec-<pid>-<n>-<reason>.json`` in the metrics dir
+  (``dump()``, tempfile + ``os.replace``) on SIGTERM drains, injected
+  SIGKILL crashes (faults.py), sentinel ejections, and promotion-gate
+  verdicts — so every ejection and rollback ships with the trace tail
+  that explains it, even when the process never got to flush.
+
+Tracing must never take down serving: an unsampled context costs one
+attribute check per hop, ``record()`` is a deque append, and
+``dump()`` swallows OSError.
+"""
+
+from __future__ import annotations
+
+import binascii
+import contextlib
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+from veles_tpu import events, knobs, telemetry
+from veles_tpu.analysis import witness
+
+#: wire-protocol field names carrying trace context.  Every member
+#: MUST be registered in veles_tpu/serve/protocol.py — veleslint's
+#: ``trace-wire-key`` rule statically cross-checks this tuple against
+#: the wire-key registry (zero waivers).
+K_TRACE = "trace"
+K_SPAN = "span"
+K_PARENT = "parent"
+K_SAMPLED = "sampled"
+WIRE_FIELDS = ("trace", "span", "parent", "sampled")
+
+
+def _hex(nbytes: int) -> str:
+    return binascii.hexlify(os.urandom(nbytes)).decode()
+
+
+def new_span_id() -> str:
+    """A fresh 8-hex span id for an event that LINKS several traces
+    instead of belonging to one (the batcher's coalesced dispatch)."""
+    return _hex(4)
+
+
+class TraceContext:
+    """One causal hop: the trace (whole request tree), this hop's
+    span, the span that caused it, and the head-sampling bit."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: Optional[str] = None,
+                 parent_id: Optional[str] = None,
+                 sampled: bool = True) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id or _hex(4)
+        self.parent_id = parent_id
+        self.sampled = bool(sampled)
+
+    def child(self) -> "TraceContext":
+        """A new span under this one (same trace, same sampling)."""
+        return TraceContext(self.trace_id, _hex(4), self.span_id,
+                            self.sampled)
+
+    def fields(self) -> Dict[str, Any]:
+        """Journal-ready ``trace``/``span``/``parent`` fields."""
+        d: Dict[str, Any] = {"trace": self.trace_id,
+                             "span": self.span_id}
+        if self.parent_id:
+            d["parent"] = self.parent_id
+        return d
+
+    def __repr__(self) -> str:
+        return (f"TraceContext({self.trace_id}/{self.span_id}"
+                f"<-{self.parent_id} sampled={self.sampled})")
+
+
+# -- minting (the router's admission edge) -----------------------------
+
+_mint_lock = witness.lock("trace.mint")
+_acc = 0.0
+
+
+def mint(environ: Optional[Dict[str, str]] = None) -> TraceContext:
+    """Mint a ROOT context.  Head-based sampling by error diffusion
+    (``acc += rate; sample when acc >= 1``, the tap.py idiom): the
+    sampled fraction of any request stream is exactly
+    ``$VELES_TRACE_SAMPLE``, not a coin flip — the overhead bench
+    compares rate 0 vs 1 on identical traffic."""
+    global _acc
+    rate = max(0.0, min(1.0, float(knobs.get(knobs.TRACE_SAMPLE,
+                                             environ))))
+    with _mint_lock:
+        _acc += rate
+        sampled = _acc >= 1.0
+        if sampled:
+            _acc -= 1.0
+    return TraceContext(_hex(8), _hex(4), None, sampled)
+
+
+# -- the thread-local current context ----------------------------------
+
+_tls = threading.local()
+
+
+def current() -> Optional[TraceContext]:
+    """The context parked on this thread (None outside ``use``)."""
+    return getattr(_tls, "ctx", None)
+
+
+@contextlib.contextmanager
+def use(ctx: Optional[TraceContext]):
+    """Park ``ctx`` thread-locally for the block: journaled telemetry
+    events inside auto-carry its trace/span (the provider seam), and
+    ``record()`` stamps ring entries with it."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
+# -- wire propagation --------------------------------------------------
+
+def to_wire(msg: Dict[str, Any],
+            ctx: Optional[TraceContext]) -> Dict[str, Any]:
+    """Stamp ``ctx`` onto an outgoing wire message IN PLACE.  Only a
+    SAMPLED context rides the wire — rate 0 adds zero bytes per hop
+    (the overhead baseline)."""
+    if ctx is not None and ctx.sampled:
+        msg[K_TRACE] = ctx.trace_id
+        msg[K_SPAN] = ctx.span_id
+        if ctx.parent_id:
+            msg[K_PARENT] = ctx.parent_id
+        msg[K_SAMPLED] = 1
+    return msg
+
+
+def from_wire(job: Dict[str, Any]) -> Optional[TraceContext]:
+    """The sender's context read off an incoming message (None when
+    the hop carried none).  The receiver's own work should run under
+    ``use(from_wire(job).child())`` so its spans parent correctly."""
+    tid = job.get(K_TRACE)
+    if not tid:
+        return None
+    return TraceContext(str(tid), str(job.get(K_SPAN) or _hex(4)),
+                        job.get(K_PARENT),
+                        bool(job.get(K_SAMPLED, 1)))
+
+
+# -- the flight recorder -----------------------------------------------
+
+_rec_lock = witness.lock("trace.flightrec")
+_ring: "deque[Dict[str, Any]]" = deque(
+    maxlen=max(16, int(knobs.get(knobs.FLIGHTREC_CAP))))
+_dump_seq = 0
+
+
+def record(name: str, ctx: Optional[TraceContext] = None,
+           **fields: Any) -> None:
+    """Append one entry to the always-armed ring.  No I/O — the hot
+    path (per request leg, per batch dispatch) may call this freely;
+    the entry only leaves memory on ``dump()``."""
+    rec: Dict[str, Any] = {"ts": round(time.time(), 3),
+                           "mono": round(time.monotonic(), 6),
+                           "ev": name}
+    c = ctx if ctx is not None else current()
+    if c is not None:
+        rec["trace"] = c.trace_id
+        rec["span"] = c.span_id
+    rec.update(fields)
+    with _rec_lock:
+        _ring.append(rec)
+
+
+def ring_entries() -> Tuple[Dict[str, Any], ...]:
+    """The ring's current entries, oldest first (tests/drills)."""
+    with _rec_lock:
+        return tuple(_ring)
+
+
+def dump(reason: str,
+         metrics_dir: Optional[str] = None) -> Optional[str]:
+    """Write the ring + the telemetry journal ring tail to
+    ``flightrec-<pid>-<n>-<reason>.json`` (tempfile + ``os.replace``
+    — a reader never sees a torn dump).  Returns the path, or None
+    when no metrics dir is configured or the write failed — a dump
+    must never take the dying process down harder."""
+    global _dump_seq
+    d = metrics_dir or telemetry.metrics_dir()
+    if not d:
+        return None
+    reason = re.sub(r"[^A-Za-z0-9_.-]+", "_", str(reason)) or "dump"
+    with _rec_lock:
+        _dump_seq += 1
+        seq = _dump_seq
+        ring = list(_ring)
+    payload = {"pid": os.getpid(), "reason": reason,
+               "ts": round(time.time(), 3),
+               "mono": round(time.monotonic(), 6),
+               "ring": ring,
+               "journal_tail": telemetry.recent_events()[-256:]}
+    path = os.path.join(d, f"flightrec-{os.getpid()}-{seq}-"
+                           f"{reason}.json")
+    try:
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=d, prefix=os.path.basename(path) + ".",
+            suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+    except (OSError, ValueError, TypeError):
+        return None
+    telemetry.event(events.EV_FLIGHTREC_DUMP, reason=reason,
+                    entries=len(ring), path=os.path.basename(path))
+    return path
+
+
+# -- the telemetry provider seam ---------------------------------------
+
+def _provider() -> Optional[Tuple[str, str]]:
+    c = current()
+    if c is None or not c.sampled:
+        return None
+    return c.trace_id, c.span_id
+
+
+telemetry.set_trace_provider(_provider)

@@ -119,6 +119,34 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, json.dumps(merged).encode(),
                    "application/json")
 
+    def _send_trace(self, trace_id: Optional[str]) -> None:
+        """``/api/traces`` (all assembled traces' critical paths) and
+        ``/api/traces?id=<trace_id>`` (one trace's events + path) —
+        the dashboard's jump from a p99 exemplar to the hops behind
+        it."""
+        from veles_tpu.obs import (assemble_traces, critical_path,
+                                   load_tree)
+        _reg, merged = load_tree(self.metrics_dir)
+        traces = assemble_traces(merged)
+        if trace_id:
+            evs = traces.get(trace_id)
+            if not evs:
+                self._send(404, json.dumps(
+                    {"error": f"unknown trace {trace_id}"}).encode(),
+                    "application/json")
+                return
+            self._send(200, json.dumps(
+                {"trace": trace_id,
+                 "critical_path": critical_path(evs),
+                 "events": evs}).encode(), "application/json")
+            return
+        rows = sorted((critical_path(evs)
+                       for evs in traces.values()),
+                      key=lambda c: c.get("total_s") or 0.0,
+                      reverse=True)
+        self._send(200, json.dumps({"traces": rows}).encode(),
+                   "application/json")
+
     def _send_metrics_page(self) -> None:
         import html
 
@@ -140,6 +168,10 @@ class _Handler(BaseHTTPRequestHandler):
 
         if self.metrics_dir and self.path.startswith("/api/metrics"):
             return self._send_metrics_json()
+        if self.metrics_dir and self.path.startswith("/api/traces"):
+            from urllib.parse import parse_qs, urlparse
+            q = parse_qs(urlparse(self.path).query)
+            return self._send_trace((q.get("id") or [None])[0])
         if self.metrics_dir and not self.path.startswith("/api/") \
                 and not self.path.startswith("/runs"):
             # Sightline mode owns the dashboard; the legacy push-feed
