@@ -877,6 +877,352 @@ def serve_metric(phase):
         return None
 
 
+def serve_mesh_metric(phase):
+    """Prism mesh serving (ISSUE 17 acceptance): a ``--mesh 8``
+    replica (8 virtual XLA:CPU devices) with a per-device HBM budget
+    UNDER one model's stacked bytes — both models must go
+    member-sharded-RESIDENT (zero LRU spills where the 1-device
+    replica thrashes), answer BITWISE what a plain 1-device replica
+    answers, and hold zero post-warmup recompiles through a sustained
+    window."""
+    if os.environ.get("BENCH_SKIP_SERVE") or \
+            os.environ.get("BENCH_SKIP_SERVE_MESH"):
+        return None
+    import tempfile
+    import textwrap
+    import threading
+
+    threads = int(os.environ.get("BENCH_SERVE_THREADS", "16"))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "32"))
+    max_wait_ms = float(os.environ.get("BENCH_SERVE_MAX_WAIT_MS", "2"))
+    window = float(os.environ.get("BENCH_SERVE_WINDOW_SEC", "4"))
+    members = int(os.environ.get("BENCH_SERVE_MEMBERS", "4"))
+    hidden = int(os.environ.get("BENCH_SERVE_HIDDEN", "512"))
+    mesh = int(os.environ.get("BENCH_SERVE_MESH", "8"))
+    try:
+        from veles_tpu import prng
+        from veles_tpu.backends import NumpyDevice
+        from veles_tpu.ensemble.packaging import pack_ensemble
+        from veles_tpu.launcher import load_workflow_module
+        from veles_tpu.serve.client import HiveClient
+
+        tmp = tempfile.mkdtemp(prefix="bench_serve_mesh_")
+        wf = os.path.join(tmp, "wf.py")
+        with open(wf, "w") as f:
+            f.write(textwrap.dedent(f"""
+                from veles_tpu import prng
+                from veles_tpu.datasets import synthetic_classification
+                from veles_tpu.loader import ArrayLoader
+                from veles_tpu.ops.standard_workflow import \\
+                    StandardWorkflow
+
+                def create_workflow(launcher):
+                    prng.seed_all(9191)
+                    train, valid, _ = synthetic_classification(
+                        64, 16, (8, 8, 1), n_classes=10, seed=3)
+                    return StandardWorkflow(
+                        loader_factory=lambda w: ArrayLoader(
+                            w, train=train, valid=valid,
+                            minibatch_size=16, name="loader"),
+                        layers=[
+                            {{"type": "all2all_tanh",
+                              "->": {{"output_sample_shape": {hidden}}},
+                              "<-": {{"learning_rate": 0.1}}}},
+                            {{"type": "softmax",
+                              "->": {{"output_sample_shape": 10}},
+                              "<-": {{"learning_rate": 0.1}}}},
+                        ],
+                        decision_config={{"max_epochs": 1}},
+                        name="serve_mesh_wf")
+            """))
+        mod = load_workflow_module(wf)
+
+        class _FL:
+            workflow = None
+
+        def build_members(seed):
+            prng.seed_all(seed)
+            w = mod.create_workflow(_FL())
+            w.initialize(device=NumpyDevice())
+            base = {fw.name: {k: np.asarray(v) for k, v in
+                              fw.gather_params().items()}
+                    for fw in w.forwards}
+            rng = np.random.default_rng(seed)
+            ms = [{"params": {fn: {pn: a + 0.02 * rng
+                                   .standard_normal(a.shape)
+                                   .astype(np.float32)
+                                   for pn, a in p.items()}
+                              for fn, p in base.items()},
+                   "valid_error": 0.0, "seed": seed, "values": None,
+                   "forward_names": [fw.name for fw in w.forwards]}
+                  for _ in range(members)]
+            return w, ms
+
+        phase(f"serve_mesh: packing 2 packages ({members} members x "
+              f"{hidden} hidden) for a {mesh}-device replica")
+        _, members_main = build_members(41)
+        _, members_shadow = build_members(42)
+        pkg_main = os.path.join(tmp, "primary.vpkg")
+        pkg_shadow = os.path.join(tmp, "shadow.vpkg")
+        pack_ensemble(pkg_main, "primary", members_main, wf)
+        pack_ensemble(pkg_shadow, "shadow", members_shadow, wf)
+        bytes_one = sum(int(np.prod(a.shape)) * 4
+                        for m in members_main
+                        for p in m["params"].values()
+                        for a in p.values())
+        # per-device budget UNDER one model: a 1-device replica can
+        # never hold both (LRU thrash); the mesh replica holds both
+        # member-sharded at ~bytes_one/members per device each
+        budget = bytes_one * 3 // 4
+
+        phase(f"serve_mesh: spawning --mesh {mesh} hive (budget "
+              f"{budget} B/device vs {bytes_one} B/model) + the "
+              f"1-device reference")
+        repo = os.path.dirname(os.path.abspath(__file__))
+        client = HiveClient(
+            {"primary": pkg_main, "shadow": pkg_shadow},
+            backend="cpu", max_batch=max_batch,
+            max_wait_ms=max_wait_ms, hbm_budget=budget,
+            env={"VELES_SERVE_MESH_SHARD": "auto"}, mesh=mesh,
+            cwd=repo)
+        flat = HiveClient(
+            {"primary": pkg_main, "shadow": pkg_shadow},
+            backend="cpu", max_batch=max_batch,
+            max_wait_ms=max_wait_ms, cwd=repo)
+        try:
+            h = client.hello
+            assert h["devices"] == mesh, h
+            sharded = sum(1 for m in h["models"].values()
+                          if m.get("sharded"))
+            resident = sum(1 for m in h["models"].values()
+                           if m.get("resident"))
+            assert sharded == 2 and resident == 2, h
+
+            # correctness gate: BITWISE vs the 1-device replica (the
+            # member-sharded build runs the identical add chain on an
+            # exactly-replicated gather)
+            rng = np.random.default_rng(0)
+            bitwise_diff = 0.0
+            for n in (1, 3, max_batch // 2):
+                x = rng.standard_normal((n, 8, 8, 1)) \
+                    .astype(np.float32)
+                for name in ("primary", "shadow"):
+                    rm = client.request(name, x, timeout=120)
+                    rf = flat.request(name, x, timeout=120)
+                    assert "probs" in rm and "probs" in rf, (rm, rf)
+                    d = float(np.abs(
+                        np.asarray(rm["probs"], np.float32) -
+                        np.asarray(rf["probs"], np.float32)).max())
+                    bitwise_diff = max(bitwise_diff, d)
+            assert bitwise_diff == 0.0, bitwise_diff
+
+            row = rng.standard_normal((1, 8, 8, 1)).astype(np.float32)
+            for _ in range(8):   # warm steady state
+                client.request("primary", row)
+                client.request("shadow", row)
+            st_mid = client.stats()
+            # the sustained window drives ONE model: the capacity
+            # claim is that BOTH stay resident regardless of traffic
+            # (asserted below from the end-of-window gauges), while
+            # interleaving two 8-program mesh dispatches on the
+            # 1-core build box only measures co-tenant thrash
+            mesh_threads = min(threads, 8)
+            phase(f"serve_mesh: sustained window ({mesh_threads} "
+                  f"clients on primary; shadow stays resident)")
+            counts = [0] * mesh_threads
+            stop_at = time.perf_counter() + window
+
+            def closed_loop(i):
+                r = np.random.default_rng(i)
+                x = r.standard_normal((1, 8, 8, 1)).astype(np.float32)
+                while time.perf_counter() < stop_at:
+                    res = client.request("primary", x, timeout=60)
+                    assert "pred" in res, res
+                    counts[i] += 1
+
+            ts = [threading.Thread(target=closed_loop, args=(i,))
+                  for i in range(mesh_threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            qps = sum(counts) / window
+            st_end = client.stats()
+        finally:
+            client.close()
+            flat.close()
+
+        lat = _serve_hist_window(
+            st_end["histograms"].get("serve.request_seconds"),
+            st_mid["histograms"].get("serve.request_seconds"))
+        c_end, c_mid = st_end["counters"], st_mid["counters"]
+        recompiles = c_end.get("serve.compiles", 0) - \
+            c_mid.get("serve.compiles", 0)
+        g = st_end["gauges"]
+        out = {
+            "serve_mesh_devices": mesh,
+            "serve_mesh_qps_sustained": round(qps, 1),
+            "serve_mesh_p50_ms": round(
+                1000 * (lat.quantile(0.5) or 0), 3),
+            "serve_mesh_p99_ms": round(
+                1000 * (lat.quantile(0.99) or 0), 3),
+            "serve_mesh_models_resident": int(
+                g.get("serve.models_resident", 0)),
+            "serve_mesh_sharded_models": int(sharded),
+            "serve_mesh_model_bytes": int(bytes_one),
+            "serve_mesh_budget_bytes_per_device": int(budget),
+            "serve_mesh_resident_bytes_per_device": int(
+                g.get("serve.resident_bytes_per_device", 0)),
+            "serve_mesh_spills": int(
+                c_end.get("serve.spills", 0)),
+            "serve_mesh_recompiles_post_warmup": int(recompiles),
+            "serve_mesh_bitwise_max_abs_diff": bitwise_diff,
+        }
+        phase(f"serve_mesh: {qps:.1f} qps, {sharded} models "
+              f"member-sharded resident "
+              f"({out['serve_mesh_resident_bytes_per_device']} "
+              f"B/device under {budget}), spills "
+              f"{out['serve_mesh_spills']}, recompiles {recompiles}, "
+              f"bitwise diff {bitwise_diff}")
+        return out
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"serve_mesh metric failed: {e}", file=sys.stderr)
+        return None
+
+
+def serve_adaptive_metric(phase):
+    """Adaptive coalescing window (ISSUE 17 satellite): interleaved
+    2s windows (the PR 16 pairing — single long windows swing with
+    the box's mood) of the SAME bursty traffic against two hives
+    serving the same package, one with the static window
+    (`VELES_SERVE_ADAPTIVE_WAIT=0`) and one adaptive.  Bursty
+    arrivals pace the batcher's gap estimator: the window stretches
+    while a burst is filling (fill rises) and collapses the moment
+    arrivals stall (the lull never inflates p99)."""
+    if os.environ.get("BENCH_SKIP_SERVE") or \
+            os.environ.get("BENCH_SKIP_SERVE_ADAPTIVE"):
+        return None
+    import tempfile
+    import threading
+
+    threads = int(os.environ.get("BENCH_ADAPTIVE_THREADS", "8"))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "32"))
+    max_wait_ms = float(os.environ.get("BENCH_SERVE_MAX_WAIT_MS", "2"))
+    window = float(os.environ.get("BENCH_ADAPTIVE_WINDOW_SEC", "8"))
+    try:
+        from veles_tpu.serve.client import HiveClient
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        from chaos_drill import _fleet_pkg
+
+        tmp = tempfile.mkdtemp(prefix="bench_adaptive_")
+        phase("adaptive: packing the drill ensemble + spawning the "
+              "static/adaptive hive pair")
+        pkg, _oracle = _fleet_pkg(tmp)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        c_static = HiveClient(
+            {"m": pkg}, backend="cpu", max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            env={"VELES_SERVE_ADAPTIVE_WAIT": "0"}, cwd=repo)
+        c_adapt = HiveClient(
+            {"m": pkg}, backend="cpu", max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            env={"VELES_SERVE_ADAPTIVE_WAIT": "1"}, cwd=repo)
+        try:
+            x0 = np.ones((1, 6, 6, 1), np.float32)
+            for c in (c_static, c_adapt):
+                assert "probs" in c.request("m", x0, timeout=120)
+                for _ in range(8):
+                    c.request("m", x0)
+
+            def bursty_window(client, seconds):
+                """Fan-out bursts (the RPC-frontend shape): each
+                client fires 4 submits back-to-back, waits for all
+                four, then sleeps a 12ms lull.  Arrivals inside a
+                burst keep pace (the adaptive window stretches and
+                fills); the lull is a stall (it collapses)."""
+                st0 = client.stats()
+                stop_at = time.perf_counter() + seconds
+
+                def loop(i):
+                    r = np.random.default_rng(i)
+                    x = r.standard_normal((1, 6, 6, 1)) \
+                        .astype(np.float32)
+                    while time.perf_counter() < stop_at:
+                        jids = [client.submit("m", x)
+                                for _ in range(4)]
+                        for jid in jids:
+                            res = client.wait_for(jid, timeout=60)
+                            assert "pred" in res, res
+                        time.sleep(0.012)
+
+                ts = [threading.Thread(target=loop, args=(i,))
+                      for i in range(threads)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                st1 = client.stats()
+                lat = _serve_hist_window(
+                    st1["histograms"].get("serve.request_seconds"),
+                    st0["histograms"].get("serve.request_seconds"))
+                c0, c1 = st0["counters"], st1["counters"]
+                rows = c1.get("serve.rows", 0) - c0.get("serve.rows",
+                                                        0)
+                slots = c1.get("serve.batch_slots", 0) - \
+                    c0.get("serve.batch_slots", 0)
+                fill = rows / slots if slots else None
+                return (1000.0 * (lat.quantile(0.99) or 0.0), fill)
+
+            rounds = max(1, int(window / 2.0))
+            phase(f"adaptive: {rounds}x interleaved 2s windows, "
+                  f"static vs adaptive ({threads} bursty clients)")
+            p99s_s, p99s_a, fills_s, fills_a = [], [], [], []
+            for _r in range(rounds):
+                p99, fill = bursty_window(c_static, 2.0)
+                p99s_s.append(p99)
+                fills_s.append(fill)
+                p99, fill = bursty_window(c_adapt, 2.0)
+                p99s_a.append(p99)
+                fills_a.append(fill)
+            st_a = c_adapt.stats()["counters"]
+        finally:
+            c_static.close()
+            c_adapt.close()
+
+        fills_s = [f for f in fills_s if f is not None]
+        fills_a = [f for f in fills_a if f is not None]
+        p99_s = float(np.median(p99s_s))
+        p99_a = float(np.median(p99s_a))
+        out = {
+            "serve_adaptive_fill_static": round(
+                float(np.median(fills_s)), 4) if fills_s else None,
+            "serve_adaptive_fill": round(
+                float(np.median(fills_a)), 4) if fills_a else None,
+            "serve_adaptive_p99_static_ms": round(p99_s, 3),
+            "serve_adaptive_p99_ms": round(p99_a, 3),
+            "serve_adaptive_p99_ratio": round(
+                p99_a / max(p99_s, 1e-9), 3),
+            "serve_adaptive_stretched": int(
+                st_a.get("serve.wait_stretched", 0)),
+            "serve_adaptive_collapsed": int(
+                st_a.get("serve.wait_collapsed", 0)),
+            "serve_adaptive_rounds": rounds,
+            "serve_adaptive_max_wait_ms": max_wait_ms,
+        }
+        phase(f"adaptive: fill {out['serve_adaptive_fill_static']} -> "
+              f"{out['serve_adaptive_fill']}, p99 {p99_s:.1f} -> "
+              f"{p99_a:.1f} ms (ratio "
+              f"{out['serve_adaptive_p99_ratio']}), stretched "
+              f"{out['serve_adaptive_stretched']} / collapsed "
+              f"{out['serve_adaptive_collapsed']}")
+        return out
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"serve_adaptive metric failed: {e}", file=sys.stderr)
+        return None
+
+
 def online_metric(phase):
     """Evergreen online learning (ISSUE 14 acceptance): a REAL
     ``--serve-models --online`` hive under sustained drifted labeled
@@ -2322,7 +2668,10 @@ def main() -> None:
         def _phase(msg):
             print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}",
                   file=sys.stderr, flush=True)
-        print(json.dumps(serve_metric(_phase)), flush=True)
+        rec = serve_metric(_phase) or {}
+        rec.update(serve_mesh_metric(_phase) or {})
+        rec.update(serve_adaptive_metric(_phase) or {})
+        print(json.dumps(rec or None), flush=True)
         return
     if "--online-only" in sys.argv:
         # fast path: ONLY the Evergreen online-learning phase (one
@@ -2688,6 +3037,15 @@ def main() -> None:
     sv = serve_metric(phase)
     if sv:
         record.update(sv)
+    emit()
+
+    phase("measuring mesh serving (Prism, --mesh 8 XLA:CPU replica)")
+    svm = serve_mesh_metric(phase)
+    if svm:
+        record.update(svm)
+    svad = serve_adaptive_metric(phase)
+    if svad:
+        record.update(svad)
     emit()
 
     phase("measuring fleet serving (Swarm, N XLA:CPU replicas)")

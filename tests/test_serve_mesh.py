@@ -1,0 +1,605 @@
+"""Prism (ISSUE 17 tentpole): a hive replica that OWNS an N-device
+mesh.  On the forced 8-virtual-device CPU backend (conftest pins
+``--xla_force_host_platform_device_count=8`` before the first jax
+import) these tests prove:
+
+(a) a member-sharded serving engine answers BITWISE identically to the
+    1-device engine — and so does a real ``--mesh 8`` subprocess
+    against a plain 1-device subprocess serving the same package;
+(b) a model over ONE device's budget goes member-sharded-RESIDENT
+    (``serve.model_sharded_resident`` journaled, ZERO spill events)
+    where the identical 1-device replica LRU-spills;
+(c) PlacementPolicy places against real heterogeneous capacities
+    (a --mesh replica advertises devices x per-device budget);
+(d) a REAL 2-replica fleet with one ``--mesh 8`` replica comes up,
+    reports the mixed topology, and answers at oracle parity;
+(e) the adaptive coalescing window (Sentinel delta-quantile gap
+    estimator) stretches while arrivals keep pace and collapses on a
+    stall — cold start stays exactly static.
+"""
+
+import json
+import os
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WF_TEXT = textwrap.dedent("""
+    from veles_tpu import prng
+    from veles_tpu.datasets import synthetic_classification
+    from veles_tpu.loader import ArrayLoader
+    from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+    def create_workflow(launcher):
+        prng.seed_all(4242)
+        train, valid, _ = synthetic_classification(
+            64, 16, (6, 6, 1), n_classes=3, seed=5)
+        return StandardWorkflow(
+            loader_factory=lambda w: ArrayLoader(
+                w, train=train, valid=valid, minibatch_size=16,
+                name="loader"),
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 12},
+                 "<-": {"learning_rate": 0.1}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.1}},
+            ],
+            decision_config={"max_epochs": 2}, name="prism_wf")
+""")
+
+
+def _build_package(d, name, seed, n_members=3):
+    """One Forge ensemble package + its host oracle ingredients."""
+    from veles_tpu import prng
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.ensemble.packaging import pack_ensemble
+    from veles_tpu.launcher import load_workflow_module
+
+    wf_path = os.path.join(d, f"wf_{name}.py")
+    with open(wf_path, "w") as f:
+        f.write(WF_TEXT)
+    mod = load_workflow_module(wf_path)
+
+    class FL:
+        workflow = None
+
+    prng.seed_all(seed)
+    w = mod.create_workflow(FL())
+    w.initialize(device=NumpyDevice())
+    base = {fw.name: {k: np.asarray(v) for k, v in
+                      fw.gather_params().items()}
+            for fw in w.forwards}
+    rng = np.random.default_rng(seed)
+    members = []
+    for _ in range(n_members):
+        params = {fn: {pn: (a + 0.05 * rng.standard_normal(a.shape)
+                            .astype(np.float32))
+                       for pn, a in p.items()}
+                  for fn, p in base.items()}
+        members.append({"params": params, "valid_error": 0.0,
+                        "seed": seed,
+                        "forward_names": [fw.name
+                                          for fw in w.forwards],
+                        "values": None})
+    pkg = os.path.join(d, f"{name}.vpkg")
+    pack_ensemble(pkg, name, members, wf_path)
+    return {"pkg": pkg, "members": members, "workflow": w}
+
+
+def _host_oracle(model, x):
+    acc = None
+    for m in model["members"]:
+        out = np.asarray(x, np.float32)
+        for fw in model["workflow"].forwards:
+            p = {k: np.asarray(v)
+                 for k, v in m["params"][fw.name].items()}
+            out, _ = fw.apply_fwd(p, out, rng=None, train=False)
+        out = np.asarray(out)
+        acc = out if acc is None else acc + out
+    return acc / len(model["members"])
+
+
+def _journal_events(metrics_dir, name):
+    out = []
+    if not os.path.isdir(metrics_dir):
+        return out
+    for fn in os.listdir(metrics_dir):
+        if not fn.startswith("journal-"):
+            continue
+        with open(os.path.join(metrics_dir, fn)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("event") == name:
+                    out.append(ev)
+    return out
+
+
+def _stacked_bytes(members):
+    return sum(int(np.prod(a.shape)) * 4
+               for m in members for p in m["params"].values()
+               for a in p.values())
+
+
+@pytest.fixture(scope="module")
+def packages(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("prism_pkgs"))
+    return {"alpha": _build_package(d, "alpha", 11),
+            "beta": _build_package(d, "beta", 22)}
+
+
+class TestShardedEngineParity:
+    """(a) in-process: the member-sharded engine on an 8-device mesh
+    is BITWISE the 1-device engine — same stable add chain, all_gather
+    is exact, padded members are never read."""
+
+    def test_mesh_engine_bitwise_vs_single_device(self, packages):
+        from veles_tpu.backends import JaxDevice
+        from veles_tpu.ops.fused import EnsembleEvalEngine
+        from veles_tpu.parallel.data_parallel import MeshJaxDevice
+        from veles_tpu.parallel.mesh import make_mesh
+
+        w = packages["alpha"]["workflow"]
+        mp = [m["params"] for m in packages["alpha"]["members"]]
+        oracle = EnsembleEvalEngine(w.forwards, mp,
+                                    JaxDevice(platform="cpu"))
+        eng = EnsembleEvalEngine(w.forwards, mp,
+                                 MeshJaxDevice(make_mesh(8)),
+                                 shard_members=True)
+        try:
+            assert eng.member_sharded
+            # 3 members pad to 8 (one per device); the answer only
+            # reads the real 3
+            assert eng._n_stacked == 8
+            assert eng.n_members == 3
+            assert eng.param_bytes == oracle.param_bytes
+            assert eng.param_bytes_per_device * 8 > eng.param_bytes
+            rng = np.random.default_rng(33)
+            for n in (1, 5, 16):
+                x = rng.standard_normal((n, 6, 6, 1)) \
+                    .astype(np.float32)
+                got = np.asarray(eng.predict_proba(x))
+                want = np.asarray(oracle.predict_proba(x))
+                assert got.dtype == want.dtype
+                assert np.array_equal(got, want)
+        finally:
+            eng.release()
+            oracle.release()
+
+    def test_spill_restore_keeps_sharded_placement_bitwise(
+            self, packages):
+        from veles_tpu.backends import JaxDevice
+        from veles_tpu.ops.fused import EnsembleEvalEngine
+        from veles_tpu.parallel.data_parallel import MeshJaxDevice
+        from veles_tpu.parallel.mesh import make_mesh
+
+        w = packages["beta"]["workflow"]
+        mp = [m["params"] for m in packages["beta"]["members"]]
+        oracle = EnsembleEvalEngine(w.forwards, mp,
+                                    JaxDevice(platform="cpu"))
+        eng = EnsembleEvalEngine(w.forwards, mp,
+                                 MeshJaxDevice(make_mesh(8)),
+                                 shard_members=True)
+        try:
+            x = np.random.default_rng(7).standard_normal(
+                (4, 6, 6, 1)).astype(np.float32)
+            want = np.asarray(oracle.predict_proba(x))
+            assert np.array_equal(
+                np.asarray(eng.predict_proba(x)), want)
+            eng.spill_params()
+            assert not eng.resident
+            eng.restore_params(mp)
+            # restore re-pads and lands on the SAME sharding: the
+            # compiled dispatcher answers without retracing
+            assert eng.member_sharded and eng.resident
+            assert np.array_equal(
+                np.asarray(eng.predict_proba(x)), want)
+        finally:
+            eng.release()
+            oracle.release()
+
+
+class TestMeshServeSubprocess:
+    """(a) over the wire: a real ``--mesh 8`` replica (forced to
+    member-shard) answers bitwise vs a plain 1-device replica."""
+
+    @pytest.fixture(scope="class")
+    def pair(self, packages, tmp_path_factory):
+        from veles_tpu.serve.client import HiveClient
+        mdir = str(tmp_path_factory.mktemp("prism_mesh"))
+        mesh_c = HiveClient(
+            {"alpha": packages["alpha"]["pkg"]}, backend="cpu",
+            max_batch=8, max_wait_ms=5, metrics_dir=mdir,
+            env={"VELES_SERVE_MESH_SHARD": "always"},
+            mesh=8, cwd=REPO)
+        flat_c = HiveClient(
+            {"alpha": packages["alpha"]["pkg"]}, backend="cpu",
+            max_batch=8, max_wait_ms=5, cwd=REPO)
+        yield {"mesh": mesh_c, "flat": flat_c, "mdir": mdir}
+        mesh_c.close()
+        flat_c.close()
+
+    def test_hello_advertises_mesh_capacity(self, pair):
+        h = pair["mesh"].hello
+        assert h["ready"] and h["platform"] == "cpu"
+        assert h["devices"] == 8
+        assert h["device_budget"] > 0
+        assert h["models"]["alpha"]["resident"]
+        assert h["models"]["alpha"]["sharded"] is True
+        flat = pair["flat"].hello
+        assert flat["devices"] == 1
+        assert flat["models"]["alpha"]["sharded"] is False
+
+    def test_mesh_serve_bitwise_vs_flat_serve(self, pair, packages):
+        rng = np.random.default_rng(99)
+        for n in (1, 3, 8):
+            x = rng.standard_normal((n, 6, 6, 1)).astype(np.float32)
+            rm = pair["mesh"].request("alpha", x, timeout=60)
+            rf = pair["flat"].request("alpha", x, timeout=60)
+            assert "probs" in rm and "probs" in rf, (rm, rf)
+            got = np.asarray(rm["probs"], np.float32)
+            ref = np.asarray(rf["probs"], np.float32)
+            assert np.array_equal(got, ref)
+            want = _host_oracle(packages["alpha"], x)
+            np.testing.assert_allclose(got, want, atol=1e-4)
+            assert rm["pred"] == rf["pred"]
+
+    def test_sharded_resident_journaled(self, pair):
+        evs = _journal_events(pair["mdir"],
+                              "serve.model_sharded_resident")
+        assert evs, "no serve.model_sharded_resident journal event"
+        ev = evs[-1]
+        assert ev["model"] == "alpha" and ev["devices"] == 8
+        assert 0 < ev["per_device"] < ev["param_bytes"]
+
+
+class TestOverBudgetGoesShardedResident:
+    """(b) the capacity win itself: with a per-device budget under ONE
+    model's bytes, the 1-device replica thrashes the LRU spill path
+    while the --mesh 8 replica holds BOTH models member-sharded
+    resident — zero spills, journal-pinned."""
+
+    def _budget(self, packages):
+        # between the sharded per-device charge (2 models x
+        # bytes_one/3 after padding 3->8) and one model's full bytes
+        bytes_one = _stacked_bytes(packages["alpha"]["members"])
+        return bytes_one * 3 // 4
+
+    def test_mesh_replica_zero_spills(self, packages,
+                                      tmp_path_factory):
+        from veles_tpu.serve.client import HiveClient
+        mdir = str(tmp_path_factory.mktemp("prism_overbudget"))
+        c = HiveClient(
+            {"alpha": packages["alpha"]["pkg"],
+             "beta": packages["beta"]["pkg"]},
+            backend="cpu", max_batch=8, max_wait_ms=5,
+            hbm_budget=self._budget(packages), metrics_dir=mdir,
+            env={"VELES_SERVE_MESH_SHARD": "auto"}, mesh=8, cwd=REPO)
+        try:
+            h = c.hello
+            # BOTH over-one-device's-budget models are resident at
+            # once — sharded, not spilled
+            for name in ("alpha", "beta"):
+                assert h["models"][name]["resident"], h
+                assert h["models"][name]["sharded"] is True, h
+            x = np.ones((2, 6, 6, 1), np.float32)
+            for name in ("alpha", "beta", "alpha", "beta"):
+                r = c.request(name, x, timeout=60)
+                assert "probs" in r, (name, r)
+                np.testing.assert_allclose(
+                    np.asarray(r["probs"]),
+                    _host_oracle(packages[name], x), atol=1e-4)
+            st = c.stats()
+            assert st["gauges"]["serve.models_resident"] == 2
+            assert st["gauges"]["serve.mesh_devices"] == 8
+            per_dev = st["gauges"]["serve.resident_bytes_per_device"]
+            assert 0 < per_dev <= self._budget(packages)
+            assert st["counters"].get("serve.spills", 0) == 0
+        finally:
+            c.close()
+        sharded = _journal_events(mdir, "serve.model_sharded_resident")
+        assert {e["model"] for e in sharded} == {"alpha", "beta"}
+        assert not _journal_events(mdir, "serve.model_spilled")
+
+    def test_single_device_replica_spills_same_budget(
+            self, packages, tmp_path_factory):
+        from veles_tpu.serve.client import HiveClient
+        mdir = str(tmp_path_factory.mktemp("prism_flat_budget"))
+        c = HiveClient(
+            {"alpha": packages["alpha"]["pkg"],
+             "beta": packages["beta"]["pkg"]},
+            backend="cpu", max_batch=8, max_wait_ms=5,
+            hbm_budget=self._budget(packages), metrics_dir=mdir,
+            cwd=REPO)
+        try:
+            assert sum(m["resident"]
+                       for m in c.hello["models"].values()) == 1
+            x = np.ones((2, 6, 6, 1), np.float32)
+            for name in ("alpha", "beta", "alpha", "beta"):
+                assert "probs" in c.request(name, x, timeout=60)
+            assert c.stats()["counters"]["serve.spills"] >= 2
+        finally:
+            c.close()
+        assert _journal_events(mdir, "serve.model_spilled")
+        assert not _journal_events(mdir,
+                                   "serve.model_sharded_resident")
+
+
+class TestHeterogeneousPlacement:
+    """(c) pure placement math against per-replica capacities."""
+
+    def _policy(self, **kw):
+        from veles_tpu.serve.fleet import PlacementPolicy
+        return PlacementPolicy(**kw)
+
+    def test_capacities_override_uniform_budget(self):
+        pl = self._policy(budget_bytes=100).assign(
+            {"a": 40, "b": 40, "c": 40, "d": 40}, 2,
+            capacities=[100, 800])
+        # the hot prefix still needs room on EVERY replica: a and b
+        # replicate, c overflows the small replica and the tail lands
+        # on the roomy mesh replica
+        assert pl["a"] == [0, 1] and pl["b"] == [0, 1]
+        assert pl["c"] == [1] and pl["d"] == [1]
+
+    def test_model_over_small_replica_fits_mesh_replica(self):
+        pl = self._policy(budget_bytes=100).assign(
+            {"big": 500}, 2, capacities=[100, 800])
+        assert pl["big"] == [1]
+
+    def test_none_capacity_falls_back_to_budget(self):
+        pl = self._policy(budget_bytes=100).assign(
+            {"a": 40, "b": 40, "c": 40}, 2, capacities=[None, 300])
+        assert pl["a"] == [0, 1] and pl["b"] == [0, 1]
+        assert pl["c"] == [1]
+
+    def test_uniform_capacities_match_legacy_tiebreak(self):
+        pol = self._policy(budget_bytes=100)
+        legacy = pol.assign({"a": 40, "b": 40, "c": 40, "d": 10}, 2)
+        hetero = pol.assign({"a": 40, "b": 40, "c": 40, "d": 10}, 2,
+                            capacities=[None, None])
+        assert hetero == legacy
+
+    def test_tail_prefers_most_free_bytes(self):
+        # c replicates (fits both); d ends the hot prefix and the
+        # tail goes where the most BYTES remain — the mesh replica
+        # (210 free vs 10), twice — not round-robin by count
+        pl = self._policy(budget_bytes=100).assign(
+            {"c": 90, "d": 90, "e": 90}, 2, capacities=[100, 300])
+        assert pl["c"] == [0, 1]
+        assert pl["d"] == [1] and pl["e"] == [1]
+
+
+class TestFleetWithMeshReplica:
+    """(d) the mixed fleet: replica 0 owns one device, replica 1 owns
+    an 8-device mesh — one fleet, real subprocesses."""
+
+    @pytest.fixture(scope="class")
+    def router(self, packages, tmp_path_factory):
+        from veles_tpu.serve.router import FleetRouter
+        mdir = str(tmp_path_factory.mktemp("prism_fleet"))
+        r = FleetRouter(
+            {"alpha": packages["alpha"]["pkg"],
+             "beta": packages["beta"]["pkg"]},
+            n_replicas=2, backend="cpu", max_batch=16, max_wait_ms=5,
+            mesh={1: 8}, metrics_dir=mdir, cwd=REPO)
+        yield r
+        r.close()
+
+    def test_mixed_topology_comes_up(self, router):
+        assert len(router.replicas) == 2
+        assert all(r.healthy for r in router.replicas)
+        assert router.replicas[0].devices == 1
+        assert router.replicas[1].devices == 8
+        # capacity = devices x per-device budget, from each hello
+        c0 = router.replicas[0].capacity_bytes
+        c1 = router.replicas[1].capacity_bytes
+        assert c0 and c1 and c1 == 8 * c0
+
+    def test_fleet_status_reports_devices(self, router):
+        st = router.fleet_status()
+        devs = [row["devices"] for row in st["replicas"]]
+        assert devs == [1, 8]
+        for row in st["replicas"]:
+            assert row["device_budget"] and row["device_budget"] > 0
+
+    def test_round_trip_matches_oracle(self, router, packages):
+        rng = np.random.default_rng(123)
+        for name in ("alpha", "beta"):
+            x = rng.standard_normal((2, 6, 6, 1)).astype(np.float32)
+            r = router.request(name, x, timeout=60)
+            assert "probs" in r, r
+            np.testing.assert_allclose(
+                np.asarray(r["probs"], np.float32),
+                _host_oracle(packages[name], x), atol=1e-4)
+
+    def test_obs_fleet_rows_show_mesh_shape(self, router):
+        # request traffic above flushed the replicas' gauges; the
+        # merged fleet view (and /api/metrics through it) reports the
+        # per-replica topology + per-device resident charge
+        from veles_tpu import telemetry
+        from veles_tpu.obs import fleet_rows, render_fleet
+        telemetry.flush()
+        deadline = time.monotonic() + 30
+        rows = []
+        while time.monotonic() < deadline:
+            rows = fleet_rows(router.metrics_dir)
+            if len(rows) == 2 and all(
+                    r.get("resident_mib_per_device") is not None
+                    for r in rows):
+                break
+            time.sleep(0.5)
+        assert [r["devices"] for r in rows] == [1, 8], rows
+        for r in rows:
+            assert r["resident_mib_per_device"] > 0, rows
+        out = render_fleet(router.metrics_dir)
+        assert "MiB/dev" in out and "devs" in out
+
+    def test_parse_mesh_cli_forms(self):
+        from veles_tpu.serve.router import parse_mesh
+        assert parse_mesh(None) is None
+        assert parse_mesh(["8"]) == 8
+        assert parse_mesh(["1=8"]) == {1: 8}
+        assert parse_mesh(["0=2", "3=8"]) == {0: 2, 3: 8}
+        with pytest.raises(ValueError):
+            parse_mesh(["8", "1=8"])
+
+
+class TestAdaptiveWait:
+    """(e) the adaptive coalescing window, deterministically: feed the
+    gap histogram by hand and read ``_wait_left`` — no sleeps, no
+    timing races."""
+
+    def _batcher(self, **kw):
+        from veles_tpu.serve.batcher import MicroBatcher
+        kw.setdefault("max_batch", 64)
+        kw.setdefault("max_wait_s", 0.02)
+        return MicroBatcher(lambda xb: xb.sum(axis=(1,)), **kw)
+
+    def test_cold_start_is_static(self):
+        b = self._batcher()
+        try:
+            assert b._adaptive and b._gap_hist is not None
+            now = time.perf_counter()
+            with b._cond:
+                left = b._wait_left(now, now - 0.005)
+            # no gaps observed yet: exactly max_wait_s - age
+            assert abs(left - (0.02 - 0.005)) < 1e-9
+        finally:
+            b.close()
+
+    def test_stall_collapses_stretched_window(self):
+        # a window held open past the static deadline whose flow then
+        # stops flushes NOW — but never before the static deadline,
+        # so the static aggregation behaviour stays the floor
+        from veles_tpu import telemetry
+        b = self._batcher(max_batch=8)
+        try:
+            for _ in range(12):
+                b._gap_hist.record(0.002)
+            now = time.perf_counter()
+            with b._cond:
+                b._last_arrival = now - 1.0   # way past 2x median gap
+                c0 = telemetry.counter("serve.wait_collapsed").value
+                # still inside the static window: holds to static
+                left = b._wait_left(now, now - 0.001)
+                assert abs(left - (b.max_wait_s - 0.001)) < 1e-9
+                # past the static deadline: collapse, flush now
+                assert b._wait_left(
+                    now, now - b.max_wait_s - 0.001) == 0.0
+                assert telemetry.counter(
+                    "serve.wait_collapsed").value == c0 + 1
+        finally:
+            b.close()
+
+    def test_filling_batch_stretches_window(self):
+        # few rows missing + sub-ms cadence: the batch is predicted
+        # to fill well inside the stretched window, so it holds open
+        # past the static deadline
+        b = self._batcher(max_batch=8)
+        try:
+            for _ in range(12):
+                b._gap_hist.record(0.001)
+            now = time.perf_counter()
+            with b._cond:
+                gap = b._gap_estimate(now)
+                assert gap is not None
+                b._last_arrival = now   # an arrival THIS instant
+                # older than the static window, yet still held open
+                left = b._wait_left(now, now - 1.5 * b.max_wait_s)
+            assert left > 0.0
+            assert left <= b._stretch * b.max_wait_s
+        finally:
+            b.close()
+
+    def test_trickle_that_cannot_fill_stays_static(self):
+        # arrivals keep pace but the cadence can NEVER fill 64 rows
+        # inside the stretched window: the request pays the static
+        # deadline, not stretch x it
+        b = self._batcher(max_batch=64)
+        try:
+            for _ in range(12):
+                b._gap_hist.record(0.002)
+            now = time.perf_counter()
+            with b._cond:
+                assert b._gap_estimate(now) is not None
+                b._last_arrival = now
+                left = b._wait_left(now, now - 1.5 * b.max_wait_s)
+            assert left <= 0.0
+        finally:
+            b.close()
+
+    def test_stretch_cap_still_flushes(self):
+        b = self._batcher(max_batch=8)
+        try:
+            for _ in range(12):
+                b._gap_hist.record(0.001)
+            now = time.perf_counter()
+            with b._cond:
+                b._gap_estimate(now)
+                b._last_arrival = now
+                left = b._wait_left(
+                    now, now - b._stretch * b.max_wait_s - 0.001)
+            assert left <= 0.0   # the age cap is stretch x static
+        finally:
+            b.close()
+
+    def test_sparse_traffic_never_waits_past_static(self):
+        # observed gaps FAR above the window: the pace bar clamps at
+        # max_wait_s, so a lone request still flushes at the static
+        # deadline instead of waiting out 2x a huge median gap (or
+        # the stretched window)
+        b = self._batcher()
+        try:
+            for _ in range(12):
+                b._gap_hist.record(0.5)
+            now = time.perf_counter()
+            with b._cond:
+                assert b._gap_estimate(now) is not None
+                b._last_arrival = now - b.max_wait_s - 0.001
+                assert b._wait_left(now, now - b.max_wait_s
+                                    - 0.001) <= 0.0
+        finally:
+            b.close()
+
+    def test_full_batch_does_not_stretch(self):
+        # queued rows at capacity: nothing left to fill — the limit
+        # stays static even while arrivals keep pace
+        b = self._batcher(max_batch=4)
+        try:
+            for _ in range(12):
+                b._gap_hist.record(0.002)
+            now = time.perf_counter()
+            with b._cond:
+                assert b._gap_estimate(now) is not None
+                b._last_arrival = now
+                b._queued_rows = 4
+                left = b._wait_left(now, now - 0.001)
+                b._queued_rows = 0
+            # bounded by the static deadline (no stretch) and by the
+            # stall re-check wake-up — never past static remaining
+            assert 0.0 < left <= b.max_wait_s - 0.001 + 1e-9
+        finally:
+            b.close()
+
+    def test_knob_off_disables_estimator(self, monkeypatch):
+        monkeypatch.setenv("VELES_SERVE_ADAPTIVE_WAIT", "0")
+        b = self._batcher()
+        try:
+            assert not b._adaptive and b._gap_hist is None
+            now = time.perf_counter()
+            with b._cond:
+                left = b._wait_left(now, now - 0.001)
+            assert abs(left - (0.02 - 0.001)) < 1e-9
+        finally:
+            b.close()

@@ -101,6 +101,7 @@ EV_SERVE_READY = _ev("serve.ready")
 EV_SERVE_MODEL_LOADED = _ev("serve.model_loaded")
 EV_SERVE_MODEL_SPILLED = _ev("serve.model_spilled")
 EV_SERVE_MODEL_RESTORED = _ev("serve.model_restored")
+EV_SERVE_MODEL_SHARDED = _ev("serve.model_sharded_resident")
 EV_SERVE_FIRST_DISPATCH = _ev("serve.first_dispatch")
 EV_SERVE_DRAIN = _ev("serve.drain")
 EV_SERVE_SHUTDOWN = _ev("serve.shutdown")
@@ -167,6 +168,8 @@ CTR_SERVE_BATCH_SLOTS = _ctr("serve.batch_slots")
 CTR_SERVE_COMPILES = _ctr("serve.compiles")
 CTR_SERVE_SPILLS = _ctr("serve.spills")
 CTR_SERVE_DEADLINE_DROPPED = _ctr("serve.deadline_dropped")
+CTR_SERVE_WAIT_COLLAPSED = _ctr("serve.wait_collapsed")
+CTR_SERVE_WAIT_STRETCHED = _ctr("serve.wait_stretched")
 
 CTR_FLEET_REQUESTS = _ctr("fleet.requests")
 CTR_FLEET_REQUEST_ERRORS = _ctr("fleet.request_errors")
@@ -223,6 +226,10 @@ GAUGE_FUSED_TRAIN_IMAGES_PER_SEC_WALL = _gauge(
 GAUGE_SERVE_QUEUE_DEPTH = _gauge("serve.queue_depth")
 GAUGE_SERVE_MODELS_RESIDENT = _gauge("serve.models_resident")
 GAUGE_SERVE_RESIDENT_BYTES = _gauge("serve.resident_bytes")
+GAUGE_SERVE_RESIDENT_BYTES_PER_DEVICE = _gauge(
+    "serve.resident_bytes_per_device")
+GAUGE_SERVE_MESH_DEVICES = _gauge("serve.mesh_devices")
+GAUGE_SERVE_EFFECTIVE_WAIT_MS = _gauge("serve.effective_wait_ms")
 GAUGE_SERVE_FIRST_DISPATCH_SECONDS = _gauge(
     "serve.first_dispatch_seconds")
 

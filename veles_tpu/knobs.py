@@ -156,6 +156,37 @@ SERVE_HBM_BUDGET = _knob(
     "HBM byte budget for resident serving models when the device "
     "reports no bytes_limit; over budget the LRU model spills to "
     "host.")
+SERVE_MESH = _knob(
+    "VELES_SERVE_MESH", 0, int,
+    "Devices a hive replica owns (the Prism arm of --serve-models): "
+    ">1 binds an N-device mesh instead of a single device, so the "
+    "fleet topology becomes replicas x mesh and residency budgets "
+    "are charged per device (0/1 keeps the single-device replica).")
+SERVE_MESH_SHARD = _knob(
+    "VELES_SERVE_MESH_SHARD", "auto", str,
+    "Shard the stacked member axis of a served ensemble over the "
+    "replica's mesh (P/N members per device, replicated request "
+    "rows): `auto` shards only when the model exceeds ONE device's "
+    "residency budget but fits sharded — the over-budget placement "
+    "becomes member-sharded-RESIDENT instead of LRU spill — "
+    "`always` shards every model on a mesh replica, `never`/`0` "
+    "keeps the replicated placement.")
+SERVE_ADAPTIVE_WAIT = _knob(
+    "VELES_SERVE_ADAPTIVE_WAIT", True, flag,
+    "Let the serving micro-batcher track the windowed arrival rate "
+    "(the Sentinel delta-quantile estimator) and adapt its flush "
+    "wait: stretch past the static deadline only while the cadence "
+    "predicts the batch fills, collapse a stalled stretch back to "
+    "it.  Strictly additive — no window flushes before the static "
+    "$VELES_SERVE_MAX_WAIT_MS deadline; off disables stretching.")
+SERVE_WAIT_STRETCH = _knob(
+    "VELES_SERVE_WAIT_STRETCH", 2.0, float,
+    "Upper bound of the adaptive batching wait as a multiple of "
+    "$VELES_SERVE_MAX_WAIT_MS: the oldest queued request never "
+    "waits longer than stretch x the static window even when "
+    "arrivals keep trickling in.  2x keeps the stretched tail "
+    "inside ~1.1x the static p99 on a busy box; raise it when "
+    "batch fill matters more than tail latency.")
 
 # -- fleet serving (Swarm) ---------------------------------------------
 
@@ -401,7 +432,8 @@ def render_table() -> str:
             "| --- | --- | --- | --- |"]
     for name in sorted(KNOBS):
         k = KNOBS[name]
-        default = "off" if k.parser is flag else \
+        default = ("on" if k.default else "off") \
+            if k.parser is flag else \
             ("(unset)" if k.default == "" else repr(k.default))
         rows.append(f"| `{name}` | {default} | {k.type_name} | "
                     f"{k.doc} |")

@@ -368,6 +368,8 @@ def fleet_rows(metrics_dir: str):
             qps = requests / (last_ts - readies[0]["ts"])
         g_res = reg.gauges.get("serve.models_resident")
         g_q = reg.gauges.get("serve.queue_depth")
+        g_dev = reg.gauges.get("serve.mesh_devices")
+        g_pd = reg.gauges.get("serve.resident_bytes_per_device")
         h = reg.histograms.get("serve.request_seconds")
         state, score, hedge_wins = overlay.get(
             idx, ("healthy", None, 0))
@@ -376,6 +378,12 @@ def fleet_rows(metrics_dir: str):
             "pid": pid,
             "spawns": len(readies),
             "models_resident": g_res.value if g_res else None,
+            # the Prism topology read: devices this replica's mesh
+            # owns (1 off-mesh) and its PER-DEVICE resident charge —
+            # the number the per-device HBM budget is spent against
+            "devices": int(g_dev.value) if g_dev else 1,
+            "resident_mib_per_device": round(
+                g_pd.value / (1 << 20), 2) if g_pd else None,
             "queue_depth": g_q.value if g_q else None,
             "requests": requests,
             "qps": round(qps, 1) if qps is not None else None,
@@ -509,7 +517,8 @@ def render_fleet(metrics_dir: str) -> str:
     reg, _snaps, _journals, events = load_dir(metrics_dir)
     out = ["-- fleet replicas --",
            f"  {'replica':>7} {'pid':>8} {'spawns':>6} "
-           f"{'resident':>8} {'queue':>6} {'requests':>9} "
+           f"{'resident':>8} {'devs':>4} {'MiB/dev':>8} "
+           f"{'queue':>6} {'requests':>9} "
            f"{'qps':>9} {'p99 ms':>9} {'state':>8} {'health':>7} "
            f"{'hedge_w':>7}"]
     for r in rows:
@@ -517,6 +526,8 @@ def render_fleet(metrics_dir: str) -> str:
         out.append(
             f"  {r['replica']:>7} {pid:>8} "
             f"{r['spawns']:>6} {_fmt(r['models_resident']):>8} "
+            f"{_fmt(r.get('devices', 1)):>4} "
+            f"{_fmt(r.get('resident_mib_per_device')):>8} "
             f"{_fmt(r['queue_depth']):>6} {_fmt(r['requests']):>9} "
             f"{_fmt(r['qps']):>9} {_fmt(r['p99_ms']):>9} "
             f"{r.get('state', 'healthy'):>8} "

@@ -910,23 +910,55 @@ class EnsembleEvalEngine:
 
     def __init__(self, forwards: List[Any],
                  member_params: List[Dict[str, Dict[str, Any]]],
-                 device: Any, compute_dtype: Any = None) -> None:
+                 device: Any, compute_dtype: Any = None,
+                 shard_members: bool = False) -> None:
         if not member_params:
             raise ValueError("empty ensemble")
         if device is None or not getattr(device, "is_jax", False):
             raise ValueError(
                 "EnsembleEvalEngine needs a jax device (TPU or "
                 "XLA:CPU); use the host predictor path on numpy")
+        mesh = getattr(device, "mesh", None)
+        if shard_members and (mesh is None
+                              or int(mesh.devices.size) < 2):
+            raise ValueError(
+                "shard_members needs a mesh device (MeshJaxDevice) "
+                "with >= 2 devices")
         self.forwards = list(forwards)
         self.device = device
         self.n_members = len(member_params)
         self.compute_dtype = compute_dtype
+        #: True = the stacked member axis is split P/N over the
+        #: replica's mesh (the Prism serving placement): each device
+        #: holds a whole tile of members, request rows replicate, and
+        #: an over-one-device's-budget ensemble serves RESIDENT at
+        #: padded/N bytes per device instead of LRU-spilling
+        self.member_sharded = bool(shard_members)
+        if self.member_sharded:
+            n = int(mesh.devices.size)
+            pad = (-(-self.n_members // n) * n) - self.n_members
+            # padded members repeat member 0: computed harmlessly
+            # under vmap, never read by the fixed-order mean below
+            member_params = list(member_params) + \
+                [member_params[0]] * pad
+        #: stacked member-axis length including mesh padding
+        self._n_stacked = len(member_params)
         #: stacked params: {fwd_name: {pname: (n_members, ...)}} in HBM
         self._params = batching.stack_member_params(
-            self.forwards, member_params, device)
+            self.forwards, member_params, device,
+            put=self._put_members if self.member_sharded else None)
         #: HBM bytes the stacked f32 params occupy — the serving
-        #: tier's residency-budget accounting
-        self.param_bytes = batching.stacked_param_bytes(member_params)
+        #: tier's residency-budget accounting (real members, unpadded)
+        self.param_bytes = batching.stacked_param_bytes(
+            member_params[:self.n_members])
+        #: the residency charge PER DEVICE: a member-sharded stack
+        #: costs padded/N on each device, a replicated one costs the
+        #: full stack everywhere
+        if self.member_sharded:
+            self.param_bytes_per_device = batching.stacked_param_bytes(
+                member_params) // int(mesh.devices.size)
+        else:
+            self.param_bytes_per_device = self.param_bytes
         self._dataset = None
         self._labels = None
         #: real (unpadded) attached rows; row-sharded attachment pads
@@ -955,6 +987,15 @@ class EnsembleEvalEngine:
         return batching.resolve_compute_dtype(self.compute_dtype,
                                               self.device)
 
+    def _put_members(self, array):
+        """Member-sharded stacked-param upload: P_pad/N members per
+        device through the ONE sharding seam, charging the padded
+        total once (not xN like a replicated put)."""
+        from veles_tpu.parallel import mesh as mesh_helpers
+        buf = mesh_helpers.put_member_sharded(self.device.mesh, array)
+        self.device.h2d_bytes += int(buf.nbytes)
+        return buf
+
     def _build(self) -> None:
         import jax
         import jax.numpy as jnp
@@ -963,6 +1004,13 @@ class EnsembleEvalEngine:
         cd = self._resolved_dtype()
         mixed = cd != jnp.float32
         cast = batching.make_caster(cd)
+        n_members = self.n_members
+        if self.member_sharded:
+            from veles_tpu.parallel import mesh as mesh_helpers
+            replicated = mesh_helpers.replicated_sharding(
+                self.device.mesh)
+        else:
+            replicated = None
 
         def member_forward(params, x):
             # ONE member's pure inference chain — the same apply_fwd
@@ -978,7 +1026,21 @@ class EnsembleEvalEngine:
         def mean_probs(params, x):
             probs = jax.vmap(member_forward, in_axes=(0, None))(
                 cast(params), x)
-            return jnp.mean(probs, axis=0)
+            # the member average is a FIXED left-to-right add chain
+            # over the real members (never the mesh-padding copies),
+            # not jnp.mean: XLA may re-associate a reduce differently
+            # between the sharded and unsharded programs, and serving
+            # parity across placements is pinned f32-exact.  On a
+            # mesh the constraint gathers the member axis first
+            # (all_gather moves bits, bitwise), so both programs run
+            # the identical chain on identical values.
+            if replicated is not None:
+                probs = jax.lax.with_sharding_constraint(
+                    probs, replicated)
+            acc = probs[0]
+            for i in range(1, n_members):
+                acc = acc + probs[i]
+            return acc / n_members
 
         def score(params, acc, x, labels, mask):
             p = mean_probs(params, x)
@@ -1289,9 +1351,18 @@ class EnsembleEvalEngine:
             str, Any]]]) -> None:
         """Re-upload spilled member params (the residency manager
         keeps the host copies — model params are immutable while
-        serving)."""
-        self._params = batching.stack_member_params(
-            self.forwards, member_params, self.device)
+        serving).  A member-sharded engine restores to the SAME
+        sharded placement, so the compiled dispatchers never retrace."""
+        if self.member_sharded:
+            pad = self._n_stacked - len(member_params)
+            member_params = list(member_params) + \
+                [member_params[0]] * pad
+            self._params = batching.stack_member_params(
+                self.forwards, member_params, self.device,
+                put=self._put_members)
+        else:
+            self._params = batching.stack_member_params(
+                self.forwards, member_params, self.device)
 
     @property
     def resident(self) -> bool:

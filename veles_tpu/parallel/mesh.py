@@ -116,6 +116,21 @@ def put_along(mesh, array: np.ndarray, spec):
         array.shape, sharding, lambda idx: array[idx])
 
 
+def put_member_sharded(mesh, array: np.ndarray):
+    """Upload a stacked member-axis array split P/N per device — the
+    ensemble/population capacity placement.  The caller has already
+    padded the member axis to a multiple of N (``batching.pad_members``
+    or a repeated member), so every device holds a whole tile."""
+    import jax
+
+    if len(array) % int(mesh.devices.size):
+        raise ValueError(
+            f"member axis {len(array)} not a multiple of the "
+            f"{int(mesh.devices.size)}-device mesh — pad members first")
+    return put_along(
+        mesh, array, jax.sharding.PartitionSpec(mesh.axis_names[0]))
+
+
 def put_row_sharded(mesh, array: np.ndarray) -> Tuple[object, int]:
     """Upload ``array`` with its leading axis row-sharded 1/N per
     device, zero-padding the tail to a whole per-device tile.

@@ -6,8 +6,22 @@ between them: concurrent ``submit()`` calls append rows to a queue,
 and a dedicated flush thread dispatches a chunk as soon as either
 
 - ``max_batch`` rows have coalesced (throughput bound), or
-- the OLDEST queued request has waited ``max_wait_s`` (latency bound —
-  a lone request never waits longer than the knob).
+- the OLDEST queued request has waited long enough (latency bound).
+
+"Long enough" defaults to ADAPTIVE ($VELES_SERVE_ADAPTIVE_WAIT): the
+batcher tracks inter-arrival gaps in a local windowed histogram (the
+Sentinel delta-quantile estimator pattern) and, once it has an
+estimate, compares the quiet time since the last submit against a
+PACE bar — 2x the windowed median gap, clamped into [0.1ms,
+``max_wait_s``].  Arrivals keeping pace with batch room left STRETCH
+the window up to ``$VELES_SERVE_WAIT_STRETCH`` x the knob (more
+traffic is provably coming — waiting fills the batch); quiet past the
+bar COLLAPSES it and flushes immediately (nothing else is coming —
+waiting only adds latency).  The clamp keeps sparse traffic honest: a
+lone request reaches the bar at the static deadline at the latest, so
+it NEVER waits past ``$VELES_SERVE_MAX_WAIT_MS``, and under clumped
+arrivals it usually flushes well before it.  Cold start (no estimate
+yet) is exactly the static behavior.
 
 Every dispatch has the SAME array shape (short batches are zero-padded
 and the padding discarded host-side), so the engine's jitted dispatch
@@ -33,7 +47,7 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
-from veles_tpu import events, faults, telemetry, trace
+from veles_tpu import events, faults, knobs, telemetry, trace
 from veles_tpu.analysis import witness
 from veles_tpu.ops import batching
 
@@ -101,6 +115,18 @@ class MicroBatcher:
         #: otherwise pinned by the first request
         self._sample_shape = tuple(sample_shape) if sample_shape \
             else None
+        #: adaptive-wait state (all mutated under _cond): a LOCAL
+        #: inter-arrival-gap histogram — per batcher, never registered
+        #: globally — plus the Sentinel-style cached windowed estimate
+        self._adaptive = bool(knobs.get(knobs.SERVE_ADAPTIVE_WAIT)) \
+            and self.max_wait_s > 0.0
+        self._stretch = max(1.0, float(
+            knobs.get(knobs.SERVE_WAIT_STRETCH)))
+        self._gap_hist = telemetry.Histogram(
+            f"batcher.{label}.gap") if self._adaptive else None
+        self._last_arrival: Optional[float] = None
+        self._gap_base = None
+        self._gap_cache: Tuple[Optional[float], float] = (None, 0.0)
         self._queued_rows = 0
         self._inflight = 0          # requests taken but not resolved
         #: monotonic ts of the last submit/resolve — with an empty
@@ -151,6 +177,11 @@ class MicroBatcher:
                     f"{self.label!r} serves {self._sample_shape}")
             self._queue.append(p)
             self._queued_rows += len(rows)
+            if self._gap_hist is not None:
+                now = time.perf_counter()
+                if self._last_arrival is not None:
+                    self._gap_hist.record(now - self._last_arrival)
+                self._last_arrival = now
             self.last_activity = time.monotonic()
             telemetry.gauge(events.GAUGE_SERVE_QUEUE_DEPTH).set(
                 self._queued_rows)
@@ -190,6 +221,81 @@ class MicroBatcher:
 
     # -- flush loop ----------------------------------------------------
 
+    def _gap_estimate(self, now: float) -> Optional[float]:
+        """Median inter-arrival gap of the RECENT window, seconds —
+        the Sentinel hedge-threshold move: a cached value recomputed
+        at most every 0.25s from the histogram's bucket deltas since
+        the last recompute, falling back to the cumulative median
+        while the window is still sparse.  None until enough gaps
+        have been observed (cold start = static behavior).  Called
+        under ``_cond``; O(buckets) at worst, a tuple read usually."""
+        est, recompute_at = self._gap_cache
+        if now < recompute_at:
+            return est
+        h = self._gap_hist
+        base, self._gap_base = self._gap_base, h.snapshot_buckets()
+        gap = h.delta_quantile(base, 0.5, min_count=8) \
+            if base is not None else None
+        if gap is None and h.count >= 8:
+            gap = h.quantile(0.5)
+        self._gap_cache = (gap, now + 0.25)
+        return gap
+
+    def _wait_left(self, now: float, oldest: float) -> float:
+        """Seconds the flush thread may still wait before dispatching
+        the oldest queued request; <= 0 flushes NOW.  Static mode:
+        ``max_wait_s`` minus the age.  Adaptive mode is purely
+        ADDITIVE on top of the static deadline — no window ever
+        flushes before ``max_wait_s`` would have, so the static
+        clump-aggregation behaviour is the floor, never degraded:
+
+        - the window STRETCHES to ``stretch x max_wait_s`` only when
+          the observed cadence predicts the batch actually FILLS
+          inside it (``gap x missing rows`` from now) AND arrivals
+          are keeping pace — a trickle that keeps pace but can never
+          fill pays the static deadline, not stretch x it;
+        - a stretched window whose flow stops (quiet past the PACE
+          bar, ``2x the windowed median gap`` clamped into
+          ``[max_wait_s/20, max_wait_s]``) COLLAPSES back: it flushes
+          at the static deadline or immediately if already past it,
+          so an aborted stretch costs at most one pace bar beyond
+          static.
+
+        The pace bar can sit far below ``max_wait_s`` without risking
+        the static behaviour: it only ever ABORTS a stretch, never
+        flushes a window the static policy would still be holding, so
+        a tight bar just means stalled stretches give up quickly."""
+        limit = self.max_wait_s
+        if self._gap_hist is not None:
+            gap = self._gap_estimate(now)
+            if gap is not None and self._last_arrival is not None:
+                need = self.max_batch - self._queued_rows
+                fills = 0 < need and \
+                    (now - oldest) + gap * need <= \
+                    self._stretch * self.max_wait_s
+                if fills:
+                    stall = now - self._last_arrival
+                    pace = min(max(2.0 * gap,
+                                   0.05 * self.max_wait_s),
+                               self.max_wait_s)
+                    if stall >= pace:
+                        # flow stopped mid-stretch: abort back to the
+                        # static deadline (flush NOW if already past)
+                        if now - oldest >= self.max_wait_s:
+                            telemetry.counter(
+                                events.CTR_SERVE_WAIT_COLLAPSED).inc()
+                            return 0.0
+                    else:
+                        # wake no later than the instant the pace bar
+                        # trips: a held-open window must re-check the
+                        # stall even when no new submit arrives to
+                        # notify the flush thread, or collapse could
+                        # never fire mid-sleep
+                        limit = self._stretch * self.max_wait_s
+                        return min(limit - (now - oldest),
+                                   pace - stall)
+        return limit - (now - oldest)
+
     def _take_batch(self) -> Optional[List[Tuple[_Pending, int, int]]]:
         """Wait for a flushable batch; returns [(request, start_row,
         n_rows)] covering up to ``max_batch`` rows, or None when closed
@@ -218,9 +324,17 @@ class MicroBatcher:
                         oldest = self._queue[0].t0
                         if self._queued_rows >= self.max_batch:
                             break
-                        wait_left = self.max_wait_s - \
-                            (time.perf_counter() - oldest)
+                        now = time.perf_counter()
+                        wait_left = self._wait_left(now, oldest)
                         if wait_left <= 0:
+                            waited = now - oldest
+                            if waited > self.max_wait_s:
+                                telemetry.counter(
+                                    events.CTR_SERVE_WAIT_STRETCHED
+                                ).inc()
+                            telemetry.gauge(
+                                events.GAUGE_SERVE_EFFECTIVE_WAIT_MS
+                            ).set(round(waited * 1000.0, 3))
                             break
                         self._cond.wait(min(wait_left, 0.05))
                     elif self._closed:

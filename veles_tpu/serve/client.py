@@ -61,12 +61,15 @@ class HiveClient:
                  env: Optional[Dict[str, str]] = None,
                  cwd: Optional[str] = None,
                  online: bool = False,
+                 mesh: int = 0,
                  start_timeout: float = 120.0) -> None:
         cmd = [sys.executable, "-m", "veles_tpu", "--serve-models"]
         cmd += [f"{name}={path}" for name, path in models.items()]
         cmd += ["-b", backend]
         if online:
             cmd += ["--online"]
+        if mesh and mesh > 1:
+            cmd += ["--mesh", str(int(mesh))]
         if max_batch is not None:
             cmd += ["--max-batch", str(max_batch)]
         if max_wait_ms is not None:
@@ -85,6 +88,17 @@ class HiveClient:
         run_env.setdefault("JAX_PLATFORMS", "cpu")
         if env:
             run_env.update(env)
+        if mesh and mesh > 1 and \
+                run_env.get("JAX_PLATFORMS", "") == "cpu" and \
+                "--xla_force_host_platform_device_count" not in \
+                run_env.get("XLA_FLAGS", ""):
+            # a CPU-backed mesh replica needs N virtual devices the
+            # same way dryrun_multichip pins them (a real TPU backend
+            # already enumerates its chips)
+            run_env["XLA_FLAGS"] = (
+                run_env.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count={int(mesh)}"
+            ).strip()
         self.proc = subprocess.Popen(
             cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             text=True, bufsize=1, env=run_env, cwd=cwd)

@@ -54,6 +54,14 @@ class PlacementPolicy:
     model that would overflow ends the replicated prefix and starts
     the partitioned long tail (greedy least-filled bin).  An explicit
     ``hot`` set overrides the prefix rule.
+
+    Replicas are HETEROGENEOUS since the Prism arm: a ``--mesh N``
+    replica advertises ``devices x device_budget`` in its hello, so
+    ``assign`` takes an optional per-replica ``capacities`` list and
+    places against REAL capacity — the replicated prefix must fit the
+    SMALLEST replica, and the greedy tail lands on the replica with
+    the most free bytes (not the least absolute fill, which would
+    starve a big mesh replica next to an empty small one).
     """
 
     def __init__(self, budget_bytes: Optional[int] = None,
@@ -63,10 +71,20 @@ class PlacementPolicy:
         self.hot = set(hot) if hot is not None else None
 
     def assign(self, model_bytes: Dict[str, int],
-               n_replicas: int) -> Dict[str, List[int]]:
+               n_replicas: int,
+               capacities: Optional[List[Optional[int]]] = None
+               ) -> Dict[str, List[int]]:
         """{model: [replica indices]} — insertion order of
-        ``model_bytes`` is the declaration order."""
+        ``model_bytes`` is the declaration order.  ``capacities`` is
+        the per-replica byte capacity (hello ``devices x
+        device_budget``); None entries (or no list) fall back to the
+        policy's uniform ``budget_bytes``."""
         n = max(1, int(n_replicas))
+        caps = [self.budget_bytes] * n
+        if capacities:
+            for i, c in enumerate(capacities[:n]):
+                if c:
+                    caps[i] = int(c)
         fill = [0] * n
         placement: Dict[str, List[int]] = {}
         replicating = True
@@ -76,14 +94,15 @@ class PlacementPolicy:
                 is_hot = name in self.hot
             else:
                 is_hot = replicating and all(
-                    f + nbytes <= self.budget_bytes for f in fill)
+                    f + nbytes <= c for f, c in zip(fill, caps))
                 if not is_hot:
                     replicating = False
             if is_hot:
                 placement[name] = list(range(n))
                 fill = [f + nbytes for f in fill]
             else:
-                r = min(range(n), key=lambda i: fill[i])
+                r = max(range(n),
+                        key=lambda i: (caps[i] - fill[i], -i))
                 placement[name] = [r]
                 fill[r] += nbytes
         return placement
@@ -101,10 +120,18 @@ class Replica(Logger):
                  metrics_dir: Optional[str] = None,
                  cwd: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None,
+                 mesh: int = 0,
                  start_timeout: float = 180.0) -> None:
         self.idx = idx
         self.models = dict(models)
         self.backend = backend
+        #: devices this replica's hive owns (--mesh N); 0/1 = one chip
+        self.mesh = int(mesh)
+        #: capacity advertised by the replica's OWN hello (devices x
+        #: per-device budget) — the heterogeneous-placement input;
+        #: None until the first spawn
+        self.devices = 1
+        self.capacity_bytes: Optional[int] = None
         self.max_batch = max_batch
         #: rows one dispatch can drain — the admission estimate's
         #: queue divisor (capacity, NOT the recent fill: dividing by
@@ -160,9 +187,17 @@ class Replica(Logger):
             heartbeat_every=self.heartbeat_every,
             metrics_dir=self.metrics_dir,
             install_dir=self.install_dir,
-            env=self.env, cwd=self.cwd,
+            env=self.env, cwd=self.cwd, mesh=self.mesh,
             start_timeout=self.start_timeout)
+        hello = self.client.hello or {}
         with self._lock:
+            # capacity comes from the replica's OWN hello — the probed
+            # per-device budget on its real device, not a router-side
+            # assumption (a mixed fleet's whole point)
+            self.devices = int(hello.get("devices") or 1)
+            budget = hello.get("device_budget")
+            self.capacity_bytes = int(budget) * self.devices \
+                if budget else None
             self.healthy = True
             self.death_kind = None
             self._consecutive_deaths = 0

@@ -161,6 +161,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="model name = Forge ensemble package path "
                         "(.vpkg from ensemble.packaging.pack_ensemble)")
     p.add_argument("-b", "--backend", default="auto")
+    p.add_argument("--mesh", type=int,
+                   default=int(knobs.get(knobs.SERVE_MESH)),
+                   help="devices this replica owns ($VELES_SERVE_MESH): "
+                        ">1 binds an N-device mesh — residency budgets "
+                        "charge per device and an over-budget model "
+                        "serves member-sharded-resident instead of "
+                        "LRU-spilling ($VELES_SERVE_MESH_SHARD)")
     p.add_argument("--max-batch", type=int,
                    default=int(knobs.get(knobs.SERVE_MAX_BATCH)),
                    help="rows per micro-batch — the ONE fixed dispatch "
@@ -223,7 +230,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         specs.append((name, path))
 
-    device = make_device(args.backend)
+    if args.mesh and args.mesh > 1:
+        # the Prism arm: this replica OWNS an N-device mesh — one
+        # MeshJaxDevice through the same make_device seam, so every
+        # downstream consumer (residency, engines, batcher) sees a
+        # device that happens to replicate rows and shard members
+        from veles_tpu.parallel.data_parallel import MeshJaxDevice
+        from veles_tpu.parallel.mesh import make_mesh
+        try:
+            device = MeshJaxDevice(make_mesh(int(args.mesh)))
+        except ValueError as e:
+            print(f"--serve-models --mesh {args.mesh}: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        device = make_device(args.backend)
     platform = getattr(device, "platform", device.backend_name)
     if not getattr(device, "is_jax", False):
         print("--serve-models needs a jax device (TPU or XLA:CPU); "
@@ -267,10 +288,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "max_batch": residency.max_batch,
         "max_wait_ms": residency.max_wait_s * 1000.0,
         "online": learner is not None,
+        # the replica advertises its REAL capacity (devices x
+        # per-device budget) so a mixed fleet's placement policy can
+        # stop assuming every replica is one chip
+        "devices": residency.n_devices,
+        "device_budget": residency.budget_bytes,
         "models": {
             m.name: {"members": len(m.member_params),
                      "param_bytes": m.param_bytes,
                      "resident": m.resident,
+                     "sharded": bool(m.engine is not None
+                                     and m.engine.member_sharded),
                      "version": m.meta.get("version")}
             for m in residency.models.values()},
     }

@@ -71,6 +71,11 @@ K_MEMBERS = _k("members")        #: per-model: ensemble member count
 K_PARAM_BYTES = _k("param_bytes")
 K_RESIDENT = _k("resident")
 K_VERSION = _k("version")
+K_SHARDED = _k("sharded")        #: per-model: member-sharded placement
+# Prism hello extras: a replica advertises its real capacity so the
+# placement policy can mix 1-device and N-device replicas
+K_DEVICES = _k("devices")        #: devices the replica's mesh owns
+K_DEVICE_BUDGET = _k("device_budget")  #: residency budget PER device
 # fleet hello extras
 K_FLEET = _k("fleet")            #: replica count (hello) / status (op)
 K_REPLICA_PIDS = _k("replica_pids")

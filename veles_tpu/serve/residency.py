@@ -14,9 +14,19 @@ drops the stacked params (the manager holds the immutable host
 copies), so a later restore pays one H2D upload, NOT a recompile.
 
 Every transition journals (``serve.model_loaded`` /
-``serve.model_spilled`` / ``serve.model_restored``) and the
-``serve.models_resident`` / ``serve.resident_bytes`` gauges track the
-live set.
+``serve.model_spilled`` / ``serve.model_restored`` /
+``serve.model_sharded_resident``) and the ``serve.models_resident`` /
+``serve.resident_bytes`` / ``serve.resident_bytes_per_device`` gauges
+track the live set.
+
+On a mesh replica (``--serve-models --mesh N``, the Prism arm) the
+budget stays PER DEVICE and a model's charge depends on its placement:
+replicated params cost ``param_bytes`` on every device, while a
+member-sharded model (``$VELES_SERVE_MESH_SHARD``) costs
+``padded/N`` per device — so a model over ONE device's budget but
+under ``total/N`` goes member-sharded-RESIDENT instead of LRU
+spilling, and capacity scales with the mesh instead of replicating it
+(the Lattice move applied to serving).
 """
 
 from __future__ import annotations
@@ -77,6 +87,9 @@ class ResidencyManager(Logger):
         #: side charges against the budget that are not stacked model
         #: params: the online tier's shadow params + replay buffers
         self.reserved: Dict[str, int] = {}
+        #: devices the replica's device owns (1 off-mesh): budgets are
+        #: per device, so a member-sharded model charges padded/N here
+        self.n_devices = int(getattr(device, "n_devices", 1))
 
     @staticmethod
     def _device_budget(device: Any) -> int:
@@ -118,10 +131,49 @@ class ResidencyManager(Logger):
             self.reserved[name] = int(nbytes)
         self._update_gauges()
 
+    # -- placement / charging ------------------------------------------
+
+    def _shard_members(self, m: HostedModel) -> bool:
+        """The Prism placement decision for ``m``'s stacked member
+        axis, mirroring ``$VELES_MESH_SHARD_DATA``'s surface: `always`
+        shards every model on a mesh replica, `never` keeps the
+        replicated placement, and `auto` shards exactly the models
+        that overflow ONE device's budget — the case that was an LRU
+        spill (or a loud over-budget admit) before the mesh existed.
+        An already-built engine's placement is fixed (restore lands on
+        the same sharding, so dispatchers never retrace)."""
+        live = getattr(m.engine, "member_sharded", None)
+        if live is not None:
+            return bool(live)
+        mesh = getattr(self.device, "mesh", None)
+        if mesh is None or self.n_devices < 2:
+            return False
+        from veles_tpu.parallel.mesh import shard_mode
+        mode = shard_mode(knobs.get(knobs.SERVE_MESH_SHARD))
+        if mode == "never":
+            return False
+        if mode == "always":
+            return True
+        return m.param_bytes > self.budget_bytes
+
+    def _charge(self, m: HostedModel) -> int:
+        """``m``'s residency cost PER DEVICE under its (decided or
+        live) placement: the full stack when replicated, padded/N
+        when member-sharded."""
+        live = getattr(m.engine, "param_bytes_per_device", None)
+        if live is not None:
+            return int(live)
+        if self._shard_members(m):
+            p = len(m.member_params)
+            p_pad = -(-p // self.n_devices) * self.n_devices
+            return (m.param_bytes // p * p_pad) // self.n_devices
+        return m.param_bytes
+
     def resident_bytes(self) -> int:
+        # PER-DEVICE charge (identical to the total off-mesh).
         # snapshot the dicts first: gauges read this from the main
         # loop while the scavenger re-charges its buffer reservation
-        return sum(m.param_bytes for m in list(self.models.values())
+        return sum(self._charge(m) for m in list(self.models.values())
                    if m.resident) + sum(tuple(self.reserved.values()))
 
     def resident_count(self) -> int:
@@ -133,6 +185,11 @@ class ResidencyManager(Logger):
             self.resident_count())
         telemetry.gauge(events.GAUGE_SERVE_RESIDENT_BYTES).set(
             self.resident_bytes())
+        telemetry.gauge(
+            events.GAUGE_SERVE_RESIDENT_BYTES_PER_DEVICE).set(
+            self.resident_bytes())
+        telemetry.gauge(events.GAUGE_SERVE_MESH_DEVICES).set(
+            self.n_devices)
 
     # -- admission -----------------------------------------------------
 
@@ -176,8 +233,10 @@ class ResidencyManager(Logger):
         if m.engine is None:
             from veles_tpu.ops.fused import EnsembleEvalEngine
             t0 = time.perf_counter()
+            shard = self._shard_members(m)
             engine = EnsembleEvalEngine(m.forwards, m.member_params,
-                                        self.device)
+                                        self.device,
+                                        shard_members=shard)
             engine.attach_batcher(self.max_batch, self.max_wait_s,
                                   label=name,
                                   sample_shape=m.sample_shape)
@@ -188,6 +247,18 @@ class ResidencyManager(Logger):
                             members=m.engine.n_members,
                             param_bytes=m.param_bytes,
                             seconds=round(time.perf_counter() - t0, 4))
+            if m.engine.member_sharded:
+                telemetry.event(
+                    events.EV_SERVE_MODEL_SHARDED, model=name,
+                    devices=self.n_devices,
+                    param_bytes=m.param_bytes,
+                    per_device=m.engine.param_bytes_per_device)
+                self.info(
+                    "model %r member-sharded over %d devices: %.2f "
+                    "MiB total, %.2f MiB/device — resident where a "
+                    "single device would spill", name, self.n_devices,
+                    m.param_bytes / (1 << 20),
+                    m.engine.param_bytes_per_device / (1 << 20))
             self.info("model %r loaded: %d members, %.2f MiB stacked",
                       name, m.engine.n_members,
                       m.param_bytes / (1 << 20))
@@ -214,14 +285,19 @@ class ResidencyManager(Logger):
         race, pinned by tests/test_online.py).  A model that alone
         exceeds the budget is admitted anyway (with a loud warning) —
         refusing it would make the budget knob a denial-of-service on
-        itself."""
-        need = incoming.param_bytes
+        itself.  On a mesh replica the charge is taken AFTER the
+        placement decision: a member-sharded model needs padded/N per
+        device, so the over-one-device's-budget case stops being a
+        spill (or a warning) and becomes a resident placement."""
+        need = self._charge(incoming)
         if need > self.budget_bytes:
             self.warning(
-                "model %r needs %d bytes, over the whole residency "
+                "model %r needs %d bytes/device, over the residency "
                 "budget (%d) — admitting alone; consider raising "
-                "$VELES_SERVE_HBM_BUDGET", incoming.name, need,
-                self.budget_bytes)
+                "$VELES_SERVE_HBM_BUDGET%s", incoming.name, need,
+                self.budget_bytes,
+                "" if self.n_devices > 1 else
+                " or serving on a mesh (--mesh N)")
         if self.resident_bytes() + need <= self.budget_bytes:
             return None, False
         candidates = [m for m in self.models.values()

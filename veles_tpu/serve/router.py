@@ -101,7 +101,8 @@ class FleetRouter(Logger):
                  probe_interval: Optional[float] = None,
                  probe_backoff_cap: Optional[float] = None,
                  env_overrides: Optional[Dict[int, Dict[str, str]]]
-                 = None) -> None:
+                 = None,
+                 mesh: Optional[Any] = None) -> None:
         if n_replicas < 1:
             raise ValueError(f"a fleet needs >= 1 replica, got "
                              f"{n_replicas}")
@@ -157,13 +158,23 @@ class FleetRouter(Logger):
             merged.update(over)
             return merged
 
+        def _replica_mesh(i: int) -> int:
+            # the Prism topology knob: an int meshes every replica,
+            # a {replica_index: devices} dict mixes 1-device and
+            # N-device replicas in one fleet
+            if mesh is None:
+                return 0
+            if isinstance(mesh, dict):
+                return int(mesh.get(i, 0))
+            return int(mesh)
+
         self.replicas = [
             Replica(i, self.models, backend=backend,
                     max_batch=max_batch, max_wait_ms=max_wait_ms,
                     hbm_budget=hbm_budget,
                     heartbeat_every=heartbeat_every,
                     metrics_dir=metrics_dir, cwd=cwd,
-                    env=_replica_env(i),
+                    env=_replica_env(i), mesh=_replica_mesh(i),
                     start_timeout=start_timeout)
             for i in range(self.n_replicas)]
         self.fleet = ReplicaSet(
@@ -173,12 +184,16 @@ class FleetRouter(Logger):
         self.hello_models = hellos[0].get("models", {})
 
         #: routing affinity: hot models on all replicas, long tail
-        #: partitioned (any healthy replica remains a fallback)
+        #: partitioned (any healthy replica remains a fallback).
+        #: Capacities come from each replica's OWN hello — a --mesh N
+        #: replica advertises devices x per-device budget, so the
+        #: split places against real, heterogeneous capacity
         policy = placement or PlacementPolicy(budget_bytes=hbm_budget)
         self.placement = policy.assign(
             {name: self.hello_models.get(name, {})
              .get("param_bytes", 0) for name in self.models},
-            self.n_replicas)
+            self.n_replicas,
+            capacities=[r.capacity_bytes for r in self.replicas])
         self._lock = witness.lock("router.state")
         self._routed = [0] * self.n_replicas
         self._mirror_acc: Dict[str, float] = {}
@@ -724,6 +739,9 @@ class FleetRouter(Logger):
                  "healthy": r.healthy, "inflight": r.inflight,
                  "routed": self.routed_counts()[r.idx],
                  "deaths": r.deaths,
+                 "devices": r.devices,
+                 "device_budget": (r.capacity_bytes // r.devices
+                                   if r.capacity_bytes else None),
                  "ema_dispatch_ms": round(
                      1000 * r.ema_dispatch_s, 3)
                  if r.ema_dispatch_s else None,
@@ -770,6 +788,26 @@ class FleetRouter(Logger):
 
 # -- the CLI front end -------------------------------------------------
 
+def parse_mesh(specs: List[str]) -> Any:
+    """``--mesh`` specs -> the FleetRouter ``mesh`` argument: a bare
+    ``N`` meshes every replica with N devices; ``I=N`` (repeatable)
+    meshes only replica I — the mixed 1-device / N-device fleet."""
+    if not specs:
+        return None
+    per: Dict[int, int] = {}
+    uniform: Optional[int] = None
+    for s in specs:
+        idx, eq, n = s.partition("=")
+        if eq:
+            per[int(idx)] = int(n)
+        else:
+            uniform = int(s)
+    if per and uniform is not None:
+        raise ValueError(
+            "mix of bare N and I=N --mesh specs; use one form")
+    return per if per else uniform
+
+
 def parse_canary(spec: str) -> Tuple[str, str, Optional[float]]:
     """``CNAME=PRIMARY[:FRACTION]`` -> (cname, primary, fraction)."""
     cname, _, rest = spec.partition("=")
@@ -797,6 +835,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "DECLARATION ORDER is the placement hotness "
                         "order")
     p.add_argument("-b", "--backend", default="auto")
+    p.add_argument("--mesh", action="append", default=[],
+                   metavar="N|I=N",
+                   help="devices per replica: a bare N meshes EVERY "
+                        "replica, I=N (repeatable) meshes only "
+                        "replica I — the fleet topology becomes "
+                        "replicas x mesh and placement follows each "
+                        "replica's advertised capacity "
+                        "($VELES_SERVE_MESH)")
     p.add_argument("--canary", action="append", default=[],
                    metavar="CNAME=PRIMARY[:FRACTION]",
                    help="register model CNAME as canary-of:PRIMARY, "
@@ -870,6 +916,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             heartbeat_every=args.heartbeat_every,
             metrics_dir=args.metrics_dir,
             canaries=canaries,
+            mesh=parse_mesh(args.mesh),
             placement=PlacementPolicy(
                 budget_bytes=args.hbm_budget or None,
                 hot=set(args.hot) if args.hot else None),
