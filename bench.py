@@ -654,6 +654,285 @@ def ga_metric(phase):
         return None
 
 
+_HANDOFF_WF = """
+from veles_tpu.models import wine
+
+def create_workflow(launcher):
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+         "<-": {"learning_rate": 0.3, "weight_decay": 0.001,
+                "gradient_moment": 0.9}},
+        {"type": "softmax", "->": {"output_sample_shape": 3},
+         "<-": {"learning_rate": 0.3, "gradient_moment": 0.9}},
+    ]
+    return wine.create_workflow(
+        launcher, layers=layers,
+        decision={"max_epochs": 4, "fail_iterations": 1})
+"""
+
+
+def _handoff_wine(lr=0.3):
+    """One wine fused workflow on XLA:CPU — the cohort substrate the
+    GA handoff phase trains (the test_ga_cohort recipe)."""
+    from veles_tpu import prng
+    from veles_tpu.backends import JaxDevice
+    from veles_tpu.models import wine
+
+    class FL:
+        workflow = None
+
+    prng._streams.clear()
+    prng.seed_all(1234)
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+         "<-": {"learning_rate": lr, "weight_decay": 0.001,
+                "gradient_moment": 0.9}},
+        {"type": "softmax", "->": {"output_sample_shape": 3},
+         "<-": {"learning_rate": lr, "gradient_moment": 0.9}},
+    ]
+    w = wine.create_workflow(
+        FL(), layers=layers,
+        decision={"max_epochs": 4, "fail_iterations": 1})
+    w.initialize(device=JaxDevice(platform="cpu"))
+    return w
+
+
+def handoff_metric(phase):
+    """GA→serving handoff (ISSUE 18 acceptance, payoff b): time from
+    the last generation's fitness landing to the FIRST served
+    response.
+
+    - **HBM path** (genetics/handoff.py): the serving scaffold — a
+      registered model with a compiled+warmed engine — is pre-built
+      from the cohort's init params OFF the critical path; the handoff
+      itself is one jitted member-axis gather of the top-K trained
+      members out of the cohort stack plus ``swap_params``.  Nothing
+      touches the host (np.savez/save are tripwired during the
+      window).
+    - **Reload oracle** (the path it replaces): fetch the winners to
+      host, write the members npz, pack a Forge package, spawn a
+      fresh hive process, first answered request — the
+      online_metric ``npz_roundtrip`` recipe applied to the GA.
+
+    Both clocks start at the same event (fitness available, cohort
+    stack still live).  Bitwise equality of the served stacked rows
+    against the trained cohort rows is asserted, not assumed."""
+    if os.environ.get("BENCH_SKIP_HANDOFF"):
+        return None
+    import tempfile
+
+    client = None
+    try:
+        from veles_tpu.ensemble.packaging import pack_ensemble
+        from veles_tpu.genetics.handoff import GAServingHandoff
+        from veles_tpu.ops.fused import PopulationTrainEngine
+        from veles_tpu.serve.client import HiveClient
+        from veles_tpu.serve.residency import ResidencyManager
+
+        n = int(os.environ.get("BENCH_HANDOFF_POPULATION", "8"))
+        k = int(os.environ.get("BENCH_HANDOFF_TOPK", "3"))
+        lrs = [round(0.05 + 0.9 * i / max(n - 1, 1), 4)
+               for i in range(n)]
+
+        phase(f"handoff: training a {n}-member wine cohort "
+              f"(XLA:CPU), pre-building the K={k} serving scaffold")
+        w = _handoff_wine()
+        rates = np.asarray([[[lr, lr], [lr, lr]] for lr in lrs],
+                           np.float32)
+        decays = np.asarray([[[0.001, 0.0], [0.0, 0.0]]] * n,
+                            np.float32)
+        engine = PopulationTrainEngine(w, rates, decays)
+        sample_shape = tuple(np.asarray(
+            w.loader.original_data.map_read()).shape[1:])
+        forward_names = [f.name for f in w.fused.forwards]
+        init_members = [
+            {fn: {pk: np.asarray(arr[i]) for pk, arr in d.items()}
+             for fn, d in engine._params.items()}
+            for i in range(k)]
+        mgr = ResidencyManager(w.fused.device,
+                               budget_bytes=512 << 20)
+        t0 = time.perf_counter()
+        ho = GAServingHandoff(mgr, "winner", w.fused.forwards,
+                              init_members,
+                              sample_shape=sample_shape)
+        # the gather compile also overlaps training: prewarm against
+        # the live (still-init) cohort stack
+        ho.prewarm(engine)
+        prebuild_sec = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        fits = np.asarray(engine.run())
+        train_sec = time.perf_counter() - t0
+        idx = ho.top_k(fits)
+        x = np.asarray(w.loader.original_data.map_read()[:4],
+                       np.float32)
+
+        # -- the HBM path, np.savez/save tripwired ------------------
+        phase(f"handoff: HBM adopt of members {idx.tolist()} + "
+              f"first served request")
+        tripped = []
+        saved = {fn: getattr(np, fn)
+                 for fn in ("savez", "savez_compressed", "save")}
+        for fn in saved:
+            setattr(np, fn,
+                    lambda *a, _n=fn, **kw: tripped.append(_n))
+        try:
+            t0 = time.perf_counter()
+            serve_engine = ho.adopt_cohort(engine, fits)
+            out = np.asarray(serve_engine.submit(x).result())
+            hbm_ms = 1000.0 * (time.perf_counter() - t0)
+        finally:
+            for fn, f in saved.items():
+                setattr(np, fn, f)
+        assert out.shape[0] == 4 and np.all(np.isfinite(out))
+        bitwise = True
+        for fn, d in serve_engine.stacked_params.items():
+            for pk, arr in d.items():
+                want = np.asarray(engine._params[fn][pk])[idx]
+                bitwise &= bool(np.array_equal(
+                    np.asarray(arr)[:k], want))
+
+        # -- the reload oracle --------------------------------------
+        phase("handoff: reload oracle (host fetch -> npz -> Forge "
+              "pack -> fresh hive -> first answer)")
+        tmp = tempfile.mkdtemp(prefix="bench_handoff_")
+        wf_path = os.path.join(tmp, "handoff_wf.py")
+        with open(wf_path, "w") as f:
+            f.write(_HANDOFF_WF)
+        t0 = time.perf_counter()
+        members = []
+        for i in idx:
+            members.append({
+                "seed": 1234, "valid_error": float(fits[i]),
+                "forward_names": forward_names,
+                "values": {"lr": lrs[int(i)]},
+                "params": {fn: {pk: np.asarray(arr[int(i)])
+                                for pk, arr in d.items()}
+                           for fn, d in engine._params.items()}})
+        pkg = pack_ensemble(os.path.join(tmp, "winner.forge.tgz"),
+                            "winner", members, wf_path)
+        client = HiveClient(
+            {"m": pkg}, backend="cpu", max_batch=mgr.max_batch,
+            max_wait_ms=1000.0 * mgr.max_wait_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        assert "probs" in client.request("m", x[:1], timeout=120)
+        reload_sec = time.perf_counter() - t0
+
+        phase(f"handoff: HBM {hbm_ms:.1f}ms vs reload "
+              f"{reload_sec:.2f}s "
+              f"({reload_sec / (hbm_ms / 1000.0):.0f}x)")
+        engine.release()
+        mgr.close()
+        w.stop()
+        return {
+            "ga_handoff_members": n,
+            "ga_handoff_topk": k,
+            "ga_handoff_train_sec": round(train_sec, 2),
+            "ga_handoff_prebuild_sec": round(prebuild_sec, 2),
+            "ga_handoff_hbm_ms": round(hbm_ms, 2),
+            "ga_handoff_reload_sec": round(reload_sec, 2),
+            "ga_handoff_speedup_x": round(
+                reload_sec / (hbm_ms / 1000.0), 1),
+            "ga_handoff_bitwise_equal": bitwise,
+            "ga_handoff_npz_free": not tripped,
+            "ga_handoff_platform": "cpu",
+        }
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"handoff metric failed: {e}", file=sys.stderr)
+        return None
+    finally:
+        if client is not None:
+            client.close()
+
+
+def cohort_streaming_metric(phase):
+    """Streaming cohorts (ISSUE 18 acceptance, payoff a):
+    ``PopulationTrainEngine`` on per-firing-uploaded data vs the
+    HBM-resident baseline — the dataset-must-fit constraint lifted.
+    The SAME synthetic classification cohort trains both ways;
+    fitness parity is exact (pinned bitwise in
+    tests/test_engine_core.py, re-asserted here) and the record
+    carries the streaming path's throughput cost honestly."""
+    if os.environ.get("BENCH_SKIP_COHORT_STREAMING"):
+        return None
+    try:
+        from veles_tpu import prng
+        from veles_tpu.backends import JaxDevice
+        from veles_tpu.datasets import synthetic_classification
+        from veles_tpu.loader import ArrayLoader
+        from veles_tpu.ops.fused import PopulationTrainEngine
+        from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+        n = int(os.environ.get("BENCH_COHORT_POPULATION", "8"))
+        n_train, n_valid, sample = 4096, 512, (16, 16, 1)
+        lrs = [round(0.02 + 0.3 * i / max(n - 1, 1), 4)
+               for i in range(n)]
+
+        def run(streaming):
+            prng._streams.clear()
+            prng.seed_all(4242)
+            train, valid, _ = synthetic_classification(
+                n_train, n_valid, sample, n_classes=10, seed=77)
+            gd = {"learning_rate": 0.1, "weight_decay": 0.0001,
+                  "gradient_moment": 0.9}
+            w = StandardWorkflow(
+                loader_factory=lambda wf: ArrayLoader(
+                    wf, train=train, valid=valid,
+                    minibatch_size=64, name="loader"),
+                layers=[
+                    {"type": "all2all_tanh",
+                     "->": {"output_sample_shape": 32}, "<-": gd},
+                    {"type": "softmax",
+                     "->": {"output_sample_shape": 10}, "<-": gd},
+                ],
+                decision_config={"max_epochs": 3},
+                name="bench_cohort")
+            w.initialize(device=JaxDevice(platform="cpu"))
+            if streaming:
+                w.loader.device_resident = False
+            rates = np.asarray(
+                [[[lr, lr], [lr, lr]] for lr in lrs], np.float32)
+            decays = np.asarray(
+                [[[0.0001, 0.0], [0.0001, 0.0]]] * n, np.float32)
+            engine = PopulationTrainEngine(w, rates, decays)
+            assert engine.streaming == streaming
+            t0 = time.perf_counter()
+            fits = np.asarray(engine.run())
+            dt = time.perf_counter() - t0
+            engine.release()
+            w.stop()
+            ds_bytes = (n_train + n_valid) * 4 * int(
+                np.prod(sample))
+            return fits, dt, ds_bytes
+
+        phase(f"cohort streaming: {n}-member synthetic cohort, "
+              f"HBM-resident baseline (XLA:CPU)")
+        fits_res, t_res, ds_bytes = run(streaming=False)
+        phase(f"cohort streaming: resident {n / t_res:.2f} "
+              f"genomes/s; same cohort on streaming "
+              f"(per-firing upload) data")
+        fits_str, t_str, _ = run(streaming=True)
+        diff = float(np.max(np.abs(fits_res - fits_str)))
+        phase(f"cohort streaming: streaming {n / t_str:.2f} "
+              f"genomes/s, fitness max |diff| {diff} "
+              f"(dataset {ds_bytes / 2**20:.1f} MiB never resident)")
+        return {
+            "cohort_streaming_members": n,
+            "cohort_streaming_dataset_mib": round(
+                ds_bytes / 2 ** 20, 2),
+            "cohort_streaming_dataset_resident_bytes": 0,
+            "cohort_resident_genomes_per_sec": round(n / t_res, 3),
+            "cohort_streaming_genomes_per_sec": round(n / t_str, 3),
+            "cohort_streaming_overhead_x": round(t_str / t_res, 2),
+            "cohort_streaming_fitness_max_abs_diff": diff,
+            "cohort_streaming_platform": "cpu",
+        }
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"cohort streaming metric failed: {e}",
+              file=sys.stderr)
+        return None
+
+
 def _serve_hist_window(after, before):
     """Reconstruct the latency distribution of ONE measurement window
     from two cumulative histogram snapshots (bucket-wise subtraction;
@@ -2151,21 +2430,21 @@ def streaming_metric(device, phase):
     quantized = bool(os.environ.get("BENCH_STREAM_QUANTIZED"))
     deadline = time.perf_counter() + STREAM_SECONDS
     try:
-        import jax
+        from veles_tpu.engine import core as engine_core
         mb = STREAM_MB
         # raw link probe: one superstep row's worth of bf16-ish bytes
         probe = np.zeros((8 << 20) // 4, np.float32)  # 8 MB
-        jax.device_put(probe, device.jax_device).block_until_ready()
+        engine_core.put(probe, device.jax_device).block_until_ready()
         t0 = time.perf_counter()
-        jax.device_put(probe, device.jax_device).block_until_ready()
+        engine_core.put(probe, device.jax_device).block_until_ready()
         link_mbps = 8.0 / max(time.perf_counter() - t0, 1e-4)
         # 1-byte probe: same byte count as uint8 elements — what the
         # quantized wire would see.  Ships in the record as the
         # 1-byte/pixel roofline next to the measured 2-byte floor.
         probe_u8 = np.zeros(8 << 20, np.uint8)  # 8 MB
-        jax.device_put(probe_u8, device.jax_device).block_until_ready()
+        engine_core.put(probe_u8, device.jax_device).block_until_ready()
         t0 = time.perf_counter()
-        jax.device_put(probe_u8, device.jax_device).block_until_ready()
+        engine_core.put(probe_u8, device.jax_device).block_until_ready()
         link_mbps_u8 = 8.0 / max(time.perf_counter() - t0, 1e-4)
         img_px = 227 * 227 * 3
         # projected floor at 1 byte/pixel from the uint8 probe
@@ -2258,7 +2537,7 @@ def streaming_metric(device, phase):
             done = 0
             for _ in range(win_firings):
                 s = time.perf_counter()
-                jax.device_put(batch, device.jax_device) \
+                engine_core.put(batch, device.jax_device) \
                     .block_until_ready()
                 put_times.append(time.perf_counter() - s)
                 done += 1
@@ -2685,6 +2964,20 @@ def main() -> None:
                   file=sys.stderr, flush=True)
         print(json.dumps(online_metric(_phase)), flush=True)
         return
+    if "--handoff-only" in sys.argv:
+        # fast path: ONLY the Keel phases (XLA:CPU, in-process) — the
+        # ISSUE 18 acceptance gate (GA->serving handoff HBM vs reload
+        # oracle + streaming-cohort parity/throughput) without the
+        # headline build
+        t0 = time.perf_counter()
+
+        def _phase(msg):
+            print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}",
+                  file=sys.stderr, flush=True)
+        rec = handoff_metric(_phase) or {}
+        rec.update(cohort_streaming_metric(_phase) or {})
+        print(json.dumps(rec or None), flush=True)
+        return
     if "--trace-only" in sys.argv:
         # fast path: ONLY the Flightline tracing phase (one XLA:CPU
         # replica) — the ISSUE 16 acceptance gate (tracing-on p99 <=
@@ -3031,6 +3324,15 @@ def main() -> None:
     ga = ga_metric(phase)
     if ga:
         record.update(ga)
+    emit()
+
+    phase("measuring GA->serving handoff (Keel, HBM vs reload)")
+    hof = handoff_metric(phase)
+    if hof:
+        record.update(hof)
+    cs = cohort_streaming_metric(phase)
+    if cs:
+        record.update(cs)
     emit()
 
     phase("measuring online serving (Hive, XLA:CPU subprocess)")

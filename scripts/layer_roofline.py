@@ -153,6 +153,7 @@ def measure_conv_layers(w, rows, mb: int, iters: int = 8,
     from jax import lax
 
     from veles_tpu.backends import make_device
+    from veles_tpu.engine import core as engine_core
 
     device = make_device("auto")
     if not device.is_jax:
@@ -189,7 +190,7 @@ def measure_conv_layers(w, rows, mb: int, iters: int = 8,
             params, _ = lax.scan(body, params, None, length=iters)
             return params
 
-        fn = jax.jit(step, donate_argnums=(0,))
+        fn = engine_core.donating_jit(step, donate=(0,))
         params = {k: device.put(np.asarray(v, np.float32))
                   for k, v in u.gather_params().items()}
         x_host = np.random.default_rng(5).standard_normal(

@@ -165,18 +165,35 @@ class TestEngineParity:
         w.stop()
         assert np.allclose(fits, oracle, atol=1e-3), (fits, oracle)
 
-    def test_streaming_loader_rejected(self):
-        """Streaming datasets fall back to per-genome — the engine
-        must refuse them loudly, not train garbage."""
+    def test_streaming_cohort_matches_resident(self):
+        """Streaming cohorts (host-assembled superstep batches, zero
+        dataset residency — the PR 18 lift of the dataset-must-fit
+        constraint) train bit-identically to resident ones: the Keel
+        stream scan consumes the same rows the resident scan gathers
+        on device."""
         from veles_tpu.ops.fused import PopulationTrainEngine
 
-        w = self.build(0.3)
-        w.fused.streaming = True
-        with pytest.raises(ValueError, match="resident"):
-            PopulationTrainEngine(
-                w, np.zeros((2, 2, 2), np.float32),
-                np.zeros((2, 2, 2), np.float32))
+        lrs = [0.3, 0.05]
+        rates = np.asarray([[[lr, lr], [lr, lr]] for lr in lrs],
+                           np.float32)
+        decays = np.asarray([[[0.001, 0.0], [0.0, 0.0]]] * len(lrs),
+                            np.float32)
+
+        w = self.build(lrs[0], fail=1)
+        engine = PopulationTrainEngine(w, rates, decays)
+        assert not engine.streaming
+        resident = engine.run()
+        engine.release()
         w.stop()
+
+        w = self.build(lrs[0], fail=1)
+        w.loader.device_resident = False    # force the streaming path
+        engine = PopulationTrainEngine(w, rates, decays)
+        assert engine.streaming
+        stream = engine.run()
+        engine.release()
+        w.stop()
+        assert np.array_equal(stream, resident), (stream, resident)
 
 
 @pytest.fixture

@@ -241,8 +241,9 @@ def test_rule_catalog_is_stable():
     assert rule_names() == [
         "atomic-write", "env-registry", "event-registry",
         "tracer-hygiene", "exit-code-literals", "lock-discipline",
-        "thread-lifecycle", "wire-protocol", "trace-wire-key",
-        "lock-order", "blocking-under-lock", "waiter-discipline"]
+        "engine-residency-seam", "thread-lifecycle", "wire-protocol",
+        "trace-wire-key", "lock-order", "blocking-under-lock",
+        "waiter-discipline"]
 
 
 # -- docs + full-repo gate ---------------------------------------------

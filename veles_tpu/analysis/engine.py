@@ -126,6 +126,12 @@ _DEFAULTS: Dict[str, Any] = {
         "veles_tpu/serve/client.py", "veles_tpu/serve/fleet.py",
         "veles_tpu/serve/router.py", "veles_tpu/serve/sentinel.py",
         "veles_tpu/online/trainer.py", "bench.py"],
+    # the residency/donation seam: the ONLY modules allowed to call
+    # jax.device_put or pass donate_argnums — everything else goes
+    # through engine.core.ExecutionCore (put / donating_jit)
+    "engine_seam_modules": [
+        "veles_tpu/engine/core.py", "veles_tpu/serve/residency.py",
+        "veles_tpu/parallel/mesh.py"],
     #: the checked-in locking law the lock-order rule verifies
     "lock_order": "veles_tpu/analysis/lock_order.json",
     # the registries themselves declare names as literals by design

@@ -419,6 +419,48 @@ class LockDisciplineRule:
         return out
 
 
+class EngineResidencySeamRule:
+    """Data residency and buffer donation are the execution core's
+    job: a stray ``jax.device_put`` bypasses the HBM arbiter's ledger
+    and a stray ``donate_argnums`` bypasses the core's donation
+    policy, so both may only appear inside the seam modules
+    (engine/core.py, serve/residency.py, parallel/mesh.py) —
+    everything else routes through ``ExecutionCore.put`` /
+    ``donating_jit``."""
+
+    name = "engine-residency-seam"
+    doc = ("`jax.device_put` call or `donate_argnums=` keyword "
+           "outside the residency seam (engine/core.py, "
+           "serve/residency.py, parallel/mesh.py) — route through "
+           "engine.core.ExecutionCore.put / donating_jit")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if _in_scope(ctx.path, ctx.config.engine_seam_modules):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node.func) == "device_put":
+                out.append(Finding(
+                    self.name, ctx.path, node.lineno,
+                    node.col_offset, "device_put",
+                    "jax.device_put outside the residency seam "
+                    "bypasses the HBM arbiter ledger — place arrays "
+                    "through engine.core.ExecutionCore.put (or "
+                    "engine.core.put for one-off host transfers)"))
+            for kw in node.keywords:
+                if kw.arg == "donate_argnums":
+                    out.append(Finding(
+                        self.name, ctx.path, kw.value.lineno,
+                        kw.value.col_offset, "donate_argnums",
+                        "donate_argnums outside the residency seam "
+                        "bypasses the core's donation policy — "
+                        "compile through engine.core.donating_jit "
+                        "(or ExecutionCore.jit(fn, donate=...))"))
+        return out
+
+
 from veles_tpu.analysis.concurrency import (  # noqa: E402 — the
     # concurrency module needs Finding/ModuleContext from engine, so
     # it cannot be imported before them
@@ -435,6 +477,7 @@ RULES = [
     TracerHygieneRule(),
     ExitCodeLiteralsRule(),
     LockDisciplineRule(),
+    EngineResidencySeamRule(),
     ThreadLifecycleRule(),
     WireProtocolRule(),
     TraceWireKeyRule(),

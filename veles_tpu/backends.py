@@ -227,7 +227,8 @@ class JaxDevice(Device):
         # in HBM (quantized ingest's 4x residency cut depends on it).
         arr = np.array(array, copy=True)
         self.h2d_bytes += arr.nbytes
-        return self._jax.device_put(arr, self.jax_device)
+        from veles_tpu.engine import core as engine_core
+        return engine_core.put(arr, self.jax_device)
 
     def get(self, buf: Any) -> np.ndarray:
         return np.asarray(buf)
@@ -249,7 +250,8 @@ class JaxDevice(Device):
         return self._jit_cache[key]
 
     def synchronize(self) -> None:
-        (self._jax.device_put(0.0, self.jax_device) + 0).block_until_ready()
+        from veles_tpu.engine import core as engine_core
+        (engine_core.put(0.0, self.jax_device) + 0).block_until_ready()
 
     def __repr__(self) -> str:
         return f"<JaxDevice {self.jax_device}>"

@@ -1,0 +1,9 @@
+"""The execution-core package (Keel).
+
+``engine.core`` is the ONE place device placement, donation, and the
+shared fused trace bodies live; every engine loop in the repo is an
+adapter over it (ops/fused.py, online/trainer.py).
+"""
+
+from veles_tpu.engine.core import (ExecutionCore, donating_jit,  # noqa: F401
+                                   put)

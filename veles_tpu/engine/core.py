@@ -1,0 +1,452 @@
+"""Keel — the ONE execution core under every engine loop.
+
+The repo used to run four engine loops — ``FusedStepRunner``,
+``EnsembleEvalEngine``, ``PopulationTrainEngine`` (ops/fused.py) and
+the online scavenger's ``ShadowTrainer`` (online/trainer.py) — each
+owning its own residency, donation, and dispatch code: four copies of
+the forward/backward trace body, four spellings of ``jax.device_put``,
+four places a donation bug could hide.  This module collapses the
+overlap into one core where the orthogonal execution flags live
+TOGETHER instead of being re-derived per loop:
+
+- **member axis**: absent (one model) or a leading stacked axis vmapped
+  over P members (:meth:`ExecutionCore.vmap_members`);
+- **data residency**: host-streaming (per-batch :func:`put` uploads),
+  HBM-resident (in-trace gather), or row-sharded resident (the
+  shard_map gather seam in ops/batching.py) — the adapters pick the
+  gather, the core owns every upload;
+- **mesh placement**: replicated / batch-sharded / member-sharded
+  shardings from parallel/mesh.py, resolved once per core;
+- **wire format**: the quantized-ingest prologue
+  (:func:`build_ingest`) is part of the shared trace, so uint8 wire
+  bytes dequantize identically in every loop;
+- **donation**: :func:`donating_jit` is THE place a ``donate_argnums``
+  is ever spelled (the ``engine-residency-seam`` lint rule forbids it
+  anywhere outside this file, serve/residency.py, parallel/mesh.py).
+
+The shared trace builders (:func:`build_forward`,
+:func:`build_backward`, :func:`build_member_forward`,
+:func:`build_mean_probs`) are the EXACT bodies the four loops traced
+before the refactor — adapters compose them into the same jaxprs, so
+f32-bitwise parity with the pre-refactor engines holds by construction
+(pinned by tests/test_engine_core.py).
+
+The core also charges its HBM footprint to the process-wide arbiter
+(serve/residency.py ``process_arbiter()``): training, GA cohorts, and
+serving draw on ONE ledger with per-pool gauges instead of
+per-subsystem budget fictions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+# -- the two seam primitives -------------------------------------------
+# Every host->device placement and every buffer donation in the repo
+# goes through these two calls (or through parallel/mesh.py /
+# serve/residency.py, the other two allowlisted seam modules).
+
+
+def put(array: Any, where: Any = None):
+    """THE ``jax.device_put`` seam: place ``array`` on ``where`` (a
+    jax device or a Sharding; the backend's default device when
+    None).  Call sites outside the seam modules are lint findings —
+    residency decisions must not scatter back across the repo."""
+    import jax
+
+    if where is None:
+        return jax.device_put(array)
+    return jax.device_put(array, where)
+
+
+def donating_jit(fn, donate: Tuple[int, ...] = (),
+                 in_shardings: Any = None, out_shardings: Any = None,
+                 static_argnums: Any = None):
+    """THE donation seam: ``jax.jit`` with ``donate_argnums`` spelled
+    exactly once in the repo.  ``donate=()`` compiles without donation
+    (the eval/predict dispatchers); sharding kwargs pass through only
+    when given, so the non-mesh call is byte-identical to a bare
+    ``jax.jit(fn, donate_argnums=...)``."""
+    import jax
+
+    kw: Dict[str, Any] = {}
+    if donate:
+        kw["donate_argnums"] = tuple(donate)
+    if in_shardings is not None:
+        kw["in_shardings"] = in_shardings
+    if out_shardings is not None:
+        kw["out_shardings"] = out_shardings
+    if static_argnums is not None:
+        kw["static_argnums"] = static_argnums
+    return jax.jit(fn, **kw)
+
+
+# -- shared trace builders ---------------------------------------------
+# The bodies below are the (formerly four-times-copied) fused trace
+# pieces.  They are pure closures over static unit lists: composing
+# them yields the same jaxpr the pre-Keel loops traced, which is what
+# keeps the refactor f32-bitwise.
+
+
+def build_ingest(dequant: Any):
+    """The wire-format prologue: identity for f32/bf16 batches, the
+    affine uint8 dequantize (f32 arithmetic, host normalization order)
+    for quantized loaders."""
+    import jax.numpy as jnp
+
+    if dequant is None:
+        return lambda x: x
+    q_scale = jnp.asarray(dequant.scale, jnp.float32)
+    q_bias = jnp.asarray(dequant.bias, jnp.float32)
+
+    def ingest(x):
+        return x.astype(jnp.float32) * q_scale + q_bias
+
+    return ingest
+
+
+def build_forward(forwards, seed: int, compute_dtype):
+    """One model's forward chain WITH residuals — the train-mode body
+    every loop traces.  The rng key chain (``fold_in(fold_in(key(seed),
+    rc), i)`` per stochastic layer) is the repo-wide dropout contract:
+    cohort members, the online shadow, and the oracle replay all hash
+    the same stream."""
+    import jax
+
+    mixed = _not_f32(compute_dtype)
+
+    def forward_pass(params, x, rng_counter, train: bool):
+        residuals = []
+        if mixed:
+            x = x.astype(compute_dtype)
+        for i, f in enumerate(forwards):
+            rng = jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(seed),
+                                   rng_counter), i) \
+                if f.stochastic else None
+            x, res = f.apply_fwd(params[f.name], x, rng=rng,
+                                 train=train)
+            residuals.append(res)
+        return x, residuals
+
+    return forward_pass
+
+
+def _not_f32(compute_dtype) -> bool:
+    import jax.numpy as jnp
+
+    return compute_dtype != jnp.float32
+
+
+def build_backward(forwards, gds, compute_dtype):
+    """The backward + SGD chain: walk the gradient units in reverse,
+    skip the chain-head err_input when nothing consumes it, and apply
+    ``update_params`` with the per-call (lr, bias-lr) row — plus the
+    per-member (wd, bias-wd) row when the caller supplies one (the
+    population engine's decays contract; ``decays=None`` omits the
+    kwarg entirely, matching the single-model loops exactly)."""
+    n_fwd = len(forwards)
+    first_gd = next((i for i, g in enumerate(gds) if g is not None),
+                    -1)
+    mixed = _not_f32(compute_dtype)
+
+    def backward_update(cparams, params, opt, residuals, err, lr,
+                        wd=None):
+        if mixed:
+            err = err.astype(compute_dtype)
+        new_params = dict(params)
+        new_opt = dict(opt)
+        for i in range(n_fwd - 1, -1, -1):
+            f, gd = forwards[i], gds[i]
+            if gd is None:
+                continue
+            if i == first_gd and gd.can_skip_err_input:
+                # nothing consumes the chain-head err_input; for conv1
+                # this skips the input-dilated transposed conv (the
+                # worst MXU op here)
+                _, grads = gd.backward_from_saved(
+                    cparams[f.name], residuals[i], err,
+                    need_err_input=False)
+                err_in = None
+            else:
+                err_in, grads = gd.backward_from_saved(
+                    cparams[f.name], residuals[i], err)
+            if grads:
+                if wd is None:
+                    p, v = gd.update_params(params[f.name], grads,
+                                            opt.get(gd.name, {}),
+                                            rates=(lr[i, 0],
+                                                   lr[i, 1]))
+                else:
+                    p, v = gd.update_params(params[f.name], grads,
+                                            opt.get(gd.name, {}),
+                                            rates=(lr[i, 0],
+                                                   lr[i, 1]),
+                                            decays=(wd[i, 0],
+                                                    wd[i, 1]))
+                new_params[f.name] = p
+                if gd.name in opt:
+                    new_opt[gd.name] = v
+            err = err_in
+        return new_params, new_opt
+
+    return backward_update
+
+
+def build_member_forward(forwards, compute_dtype):
+    """One member's pure inference chain (no rng, f32 output) — the
+    body vmapped over a stacked member axis by the ensemble
+    dispatchers and the shadow scorer."""
+    import jax.numpy as jnp
+
+    mixed = _not_f32(compute_dtype)
+
+    def member_forward(params, x):
+        if mixed:
+            x = x.astype(compute_dtype)
+        for f in forwards:
+            x, _ = f.apply_fwd(params[f.name], x, rng=None,
+                               train=False)
+        return x.astype(jnp.float32)
+
+    return member_forward
+
+
+def build_mean_probs(forwards, n_members: int, compute_dtype,
+                     replicated: Any = None):
+    """The ensemble's mean member probabilities: vmap the member
+    forward over the stacked axis and average with a FIXED
+    left-to-right add chain over the REAL members (never mesh-padding
+    copies) — XLA may re-associate a ``jnp.mean`` differently between
+    sharded and unsharded programs, and serving parity across
+    placements is pinned f32-exact.  On a mesh the ``replicated``
+    constraint gathers the member axis first (all_gather moves bits,
+    bitwise), so both programs run the identical chain."""
+    import jax
+
+    from veles_tpu.ops import batching
+
+    cast = batching.make_caster(compute_dtype)
+    member_forward = build_member_forward(forwards, compute_dtype)
+
+    def mean_probs(params, x):
+        probs = jax.vmap(member_forward, in_axes=(0, None))(
+            cast(params), x)
+        if replicated is not None:
+            probs = jax.lax.with_sharding_constraint(probs, replicated)
+        acc = probs[0]
+        for i in range(1, n_members):
+            acc = acc + probs[i]
+        return acc / n_members
+
+    return mean_probs
+
+
+# -- the core ----------------------------------------------------------
+
+
+class ExecutionCore:
+    """One engine loop's placement + compile + budget surface.
+
+    Flags are orthogonal and resolved ONCE at construction:
+
+    ``device``
+        the framework device (backends.JaxDevice / MeshJaxDevice);
+    ``mesh``
+        a ``jax.sharding.Mesh`` (or None off-mesh) — placement
+        properties (:attr:`replicated`, :attr:`batch_sharding`,
+        :attr:`row_sharding`, :attr:`member_axis_sharding`) resolve
+        against it;
+    ``donate``
+        whether :meth:`jit` actually donates (False pins buffers for
+        debugging without touching adapter code);
+    ``pool``
+        which arbiter ledger pool this core's HBM footprint charges
+        (``train`` / ``cohort`` / ``serve`` / ``scratch``).
+    """
+
+    def __init__(self, device: Any = None, mesh: Any = None, *,
+                 donate: bool = True, pool: str = "train",
+                 name: Optional[str] = None) -> None:
+        self.device = device
+        self.mesh = mesh if (mesh is not None
+                             and int(mesh.devices.size) > 1) else None
+        self.donate = bool(donate)
+        self.pool = str(pool)
+        self.name = name
+        self._shardings: Dict[str, Any] = {}
+        self._zeros_cache: Dict[Tuple[int, ...], Any] = {}
+        self._replicate_fn = None
+        self._charge_key: Optional[str] = None
+
+    # -- placement -----------------------------------------------------
+
+    @property
+    def on_mesh(self) -> bool:
+        return self.mesh is not None
+
+    @property
+    def jax_device(self):
+        return getattr(self.device, "jax_device", None)
+
+    def _sharding(self, kind: str):
+        s = self._shardings.get(kind)
+        if s is None and self.on_mesh:
+            from veles_tpu.parallel import mesh as mesh_helpers
+            if kind == "replicated":
+                s = mesh_helpers.replicated_sharding(self.mesh)
+            elif kind == "batch":
+                # superstep batches are (k, mb, ...): shard the
+                # MINIBATCH axis
+                import jax.sharding as shd
+                s = shd.NamedSharding(
+                    self.mesh,
+                    shd.PartitionSpec(None, self.mesh.axis_names[0]))
+            elif kind == "rows":
+                s = mesh_helpers.row_sharding(self.mesh)
+            elif kind == "members":
+                s = mesh_helpers.member_sharding(self.mesh)
+            self._shardings[kind] = s
+        return s
+
+    @property
+    def replicated(self):
+        """Params/scalars placement on the mesh (None off-mesh)."""
+        return self._sharding("replicated")
+
+    @property
+    def batch_sharding(self):
+        """(k, mb, ...) superstep batches: minibatch axis over the
+        data axis (None off-mesh — the single-device jit consumes
+        host numpy directly)."""
+        return self._sharding("batch")
+
+    @property
+    def row_sharding(self):
+        """Resident dataset rows 1/N per device (None off-mesh)."""
+        return self._sharding("rows")
+
+    @property
+    def member_axis_sharding(self):
+        """Stacked member axis P/N per device (None off-mesh)."""
+        return self._sharding("members")
+
+    def put(self, array: Any, where: Any = None):
+        """Place ``array``: on ``where`` when given, else on the
+        core's default device."""
+        if where is None:
+            where = self.jax_device
+        return put(array, where)
+
+    def put_members(self, array: np.ndarray):
+        """Upload a member-axis-leading array: sharded P/N per device
+        on a mesh (multihost-safe ``make_array_from_callback``
+        placement, H2D bytes charged to the device accounting), a
+        plain device put otherwise."""
+        if not self.on_mesh:
+            return self.device.put(array)
+        from veles_tpu.parallel import mesh as mesh_helpers
+        buf = mesh_helpers.put_member_sharded(self.mesh,
+                                              np.asarray(array))
+        self.device.h2d_bytes += int(buf.nbytes)
+        return buf
+
+    def put_replicated(self, array: np.ndarray):
+        """Replicate a host array over the mesh (dataset, targets,
+        superstep indices/masks), or hand it through untouched
+        off-mesh — the single-device jit consumes host numpy
+        directly, as before."""
+        if not self.on_mesh:
+            return array
+        import jax.sharding as shd
+
+        from veles_tpu.parallel import mesh as mesh_helpers
+        return mesh_helpers.put_along(self.mesh, np.asarray(array),
+                                      shd.PartitionSpec())
+
+    def zeros_members(self, shape) -> Any:
+        """A member-axis-leading zeros buffer under the member
+        sharding — cached per shape (a fresh jit per accumulator
+        reset would retrace every class end)."""
+        if not self.on_mesh:
+            return self.device.zeros(shape, np.float32)
+        key = tuple(int(s) for s in shape)
+        fn = self._zeros_cache.get(key)
+        if fn is None:
+            import jax.numpy as jnp
+            fn = donating_jit(
+                lambda: jnp.zeros(key, jnp.float32),
+                out_shardings=self.member_axis_sharding)
+            self._zeros_cache[key] = fn
+        return fn()
+
+    def replicate_for_fetch(self, array: Any) -> Any:
+        """Re-lay a (member-)sharded array out replicated so every
+        process can host-fetch it (the multihost-safe
+        materialization); identity off-mesh."""
+        if not self.on_mesh:
+            return array
+        if self._replicate_fn is None:
+            self._replicate_fn = donating_jit(
+                lambda a: a, out_shardings=self.replicated)
+        return self._replicate_fn(array)
+
+    # -- compile -------------------------------------------------------
+
+    def jit(self, fn, donate: Tuple[int, ...] = (),
+            in_shardings: Any = None, out_shardings: Any = None):
+        """Compile through the donation seam; ``donate`` is dropped
+        when the core was built with ``donate=False``."""
+        return donating_jit(
+            fn, donate=donate if self.donate else (),
+            in_shardings=in_shardings, out_shardings=out_shardings)
+
+    @staticmethod
+    def vmap_members(fn, in_axes):
+        """Lift ``fn`` over the leading stacked member axis (axis 0
+        where ``in_axes`` says so, broadcast where None)."""
+        import jax
+
+        return jax.vmap(fn, in_axes=in_axes)
+
+    # -- the process HBM arbiter ---------------------------------------
+
+    def charge(self, nbytes: int, label: Optional[str] = None) -> None:
+        """Charge this core's HBM footprint to the process-wide
+        arbiter's ledger under :attr:`pool` (re-charging under the
+        same key replaces, so a growing footprint stays one entry)."""
+        from veles_tpu.serve import residency
+
+        if self._charge_key is None:
+            self._charge_key = (f"{self.pool}:"
+                                f"{label or self.name or hex(id(self))}")
+        residency.process_arbiter(self.device).reserve(
+            self._charge_key, int(nbytes), pool=self.pool)
+
+    def discharge(self) -> None:
+        """Release this core's ledger entry (engine release path)."""
+        if self._charge_key is None:
+            return
+        from veles_tpu.serve import residency
+
+        residency.process_arbiter(self.device).release(
+            self._charge_key)
+        self._charge_key = None
+
+    def release(self) -> None:
+        """Drop cached dispatchers and the ledger charge."""
+        self._zeros_cache.clear()
+        self._replicate_fn = None
+        self.discharge()
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total leaf bytes of a (possibly nested dict) param/opt pytree —
+    the arbiter-charge accounting (works on host numpy and device
+    arrays alike)."""
+    import jax
+
+    return sum(int(getattr(leaf, "nbytes", 0))
+               for leaf in jax.tree_util.tree_leaves(tree))

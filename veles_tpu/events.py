@@ -84,6 +84,7 @@ EV_GA_GENOME_RETRY = _ev("ga.genome_retry")
 EV_GA_CHECKPOINT_FALLBACK = _ev("ga.checkpoint_fallback")
 EV_GA_CHECKPOINT_UNRECOVERABLE = _ev("ga.checkpoint_unrecoverable")
 EV_GA_RESUMED = _ev("ga.resumed")
+EV_GA_HANDOFF = _ev("ga.handoff")
 
 EV_PREEMPT_REQUESTED = _ev("preempt.requested")
 EV_PREEMPT_DEADLINE_EXCEEDED = _ev("preempt.deadline_exceeded")
@@ -229,6 +230,8 @@ GAUGE_SERVE_RESIDENT_BYTES = _gauge("serve.resident_bytes")
 GAUGE_SERVE_RESIDENT_BYTES_PER_DEVICE = _gauge(
     "serve.resident_bytes_per_device")
 GAUGE_SERVE_MESH_DEVICES = _gauge("serve.mesh_devices")
+GAUGE_ARBITER_BUDGET_BYTES = _gauge("arbiter.budget_bytes")
+GAUGE_ARBITER_RESIDENT_BYTES = _gauge("arbiter.resident_bytes")
 GAUGE_SERVE_EFFECTIVE_WAIT_MS = _gauge("serve.effective_wait_ms")
 GAUGE_SERVE_FIRST_DISPATCH_SECONDS = _gauge(
     "serve.first_dispatch_seconds")
@@ -306,6 +309,7 @@ DYNAMIC_FAMILIES = (
     "online.model.<name>.buffer_rows",
     "online.model.<name>.steps",
     "online.model.<name>.gate_state",
+    "arbiter.pool.<pool>.resident_bytes",
 )
 
 

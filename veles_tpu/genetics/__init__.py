@@ -24,13 +24,17 @@ from veles_tpu.genetics.core import (GeneticOptimizer, Tune, find_tunes,
 
 __all__ = ["Tune", "GeneticOptimizer", "find_tunes",
            "substitute_tunes", "liftable_tune", "shape_signature",
-           "ChipEvaluatorPool"]
+           "ChipEvaluatorPool", "GAServingHandoff"]
 
 
 def __getattr__(name):
-    # pool.py pulls in subprocess machinery; keep `import
-    # veles_tpu.genetics` light for the workers that only need Tune
+    # pool.py / handoff.py pull in subprocess + serving machinery;
+    # keep `import veles_tpu.genetics` light for the workers that
+    # only need Tune
     if name == "ChipEvaluatorPool":
         from veles_tpu.genetics.pool import ChipEvaluatorPool
         return ChipEvaluatorPool
+    if name == "GAServingHandoff":
+        from veles_tpu.genetics.handoff import GAServingHandoff
+        return GAServingHandoff
     raise AttributeError(name)

@@ -136,8 +136,9 @@ HEARTBEAT_EVERY = _knob(
     "(0 disables).")
 TPU_GA_HBM_BUDGET = _knob(
     "VELES_TPU_GA_HBM_BUDGET", 8 << 30, int,
-    "HBM byte budget for population-batched cohort sizing when the "
-    "device reports no bytes_limit.")
+    "LEGACY fallback (superseded by $VELES_HBM_BUDGET): HBM byte "
+    "budget for population-batched cohort sizing when the device "
+    "reports no bytes_limit.")
 
 # -- online serving (Hive) ---------------------------------------------
 
@@ -151,11 +152,19 @@ SERVE_MAX_BATCH = _knob(
     "Rows per serving micro-batch: the batcher flushes as soon as "
     "this many rows coalesce (also the ONE fixed dispatch shape — "
     "zero steady-state recompiles).")
+HBM_BUDGET = _knob(
+    "VELES_HBM_BUDGET", 0, int,
+    "Unified PER-DEVICE HBM byte budget of the process-wide arbiter "
+    "(engine/core.py charges training, GA cohorts, and serving "
+    "against ONE ledger): non-zero overrides the device's probed "
+    "bytes_limit and the legacy per-subsystem fallbacks "
+    "($VELES_SERVE_HBM_BUDGET, $VELES_TPU_GA_HBM_BUDGET); 0 keeps "
+    "probe-then-fallback.")
 SERVE_HBM_BUDGET = _knob(
     "VELES_SERVE_HBM_BUDGET", 8 << 30, int,
-    "HBM byte budget for resident serving models when the device "
-    "reports no bytes_limit; over budget the LRU model spills to "
-    "host.")
+    "LEGACY fallback (superseded by $VELES_HBM_BUDGET): HBM byte "
+    "budget for resident serving models when the device reports no "
+    "bytes_limit; over budget the LRU model spills to host.")
 SERVE_MESH = _knob(
     "VELES_SERVE_MESH", 0, int,
     "Devices a hive replica owns (the Prism arm of --serve-models): "

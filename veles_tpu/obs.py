@@ -392,6 +392,9 @@ def fleet_rows(metrics_dir: str):
             "state": state,
             "health_score": score,
             "hedge_wins": hedge_wins,
+            # the replica's HBM arbiter ledger (per-pool resident
+            # bytes) — None for pre-Keel replicas
+            "arbiter": arbiter_ledger(reg),
         })
     return rows
 
@@ -432,6 +435,60 @@ def fleet_model_rows(reg: Registry, events):
             "canary_fraction": c.get("fraction") if c else None,
         })
     return rows
+
+
+def arbiter_ledger(reg: Registry):
+    """The process HBM arbiter's ledger from its gauge family
+    (``arbiter.budget_bytes`` / ``arbiter.resident_bytes`` /
+    ``arbiter.pool.<pool>.resident_bytes``): budget, total resident,
+    utilization, and the per-pool split (serve / train / cohort /
+    scratch).  None when the process never charged the arbiter —
+    a numpy-backend run, or a pre-Keel snapshot."""
+    budget = reg.gauges.get("arbiter.budget_bytes")
+    if budget is None or budget.value is None:
+        return None
+    pools = {}
+    for n, g in reg.gauges.items():
+        m = re.match(r"arbiter\.pool\.(.+)\.resident_bytes$", n)
+        if m and g.value is not None:
+            pools[m.group(1)] = int(g.value)
+    total = reg.gauges.get("arbiter.resident_bytes")
+    resident = int(total.value) if total and total.value is not None \
+        else sum(pools.values())
+    return {
+        "budget_bytes": int(budget.value),
+        "resident_bytes": resident,
+        "utilization": round(resident / budget.value, 4)
+        if budget.value else None,
+        "pools": pools,
+    }
+
+
+def render_arbiter(reg: Registry, label: str = "") -> str:
+    """The HBM arbiter panel (empty string when the process never
+    charged it): one budget line + the per-pool resident split, in
+    MiB — the "who is holding HBM" read across training, GA cohorts,
+    and serving."""
+    led = arbiter_ledger(reg)
+    if led is None:
+        return ""
+    mib = 1 << 20
+
+    def as_mib(v):
+        return _fmt(round(v / mib, 2))
+
+    head = "-- hbm arbiter" + (f" ({label})" if label else "") + " --"
+    util = f" ({100.0 * led['utilization']:.1f}%)" \
+        if led.get("utilization") is not None else ""
+    out = [head,
+           f"  resident {as_mib(led['resident_bytes'])} MiB of "
+           f"{as_mib(led['budget_bytes'])} MiB budget{util}"]
+    pools = led["pools"]
+    if pools:
+        out.append("  " + "  ".join(
+            f"{pool}={as_mib(pools[pool])} MiB"
+            for pool in sorted(pools, key=lambda p: -pools[p])))
+    return "\n".join(out)
 
 
 def learner_rows(reg: Registry, events):
@@ -533,6 +590,26 @@ def render_fleet(metrics_dir: str) -> str:
             f"{r.get('state', 'healthy'):>8} "
             f"{_fmt(r.get('health_score')):>7} "
             f"{_fmt(r.get('hedge_wins', 0)):>7}")
+    arb = [(r["replica"], r["arbiter"]) for r in rows
+           if r.get("arbiter")]
+    if arb:
+        out.append("")
+        out.append("-- hbm arbiter (per-replica resident MiB) --")
+        out.append(f"  {'replica':>7} {'budget':>9} {'resident':>9} "
+                   f"{'util%':>6} {'serve':>9} {'train':>9} "
+                   f"{'cohort':>9} {'scratch':>9}")
+        mib = 1 << 20
+        for idx, led in arb:
+            pools = led["pools"]
+            util = f"{100.0 * led['utilization']:.1f}" \
+                if led.get("utilization") is not None else "-"
+            out.append(
+                f"  {idx:>7} "
+                f"{_fmt(round(led['budget_bytes'] / mib, 1)):>9} "
+                f"{_fmt(round(led['resident_bytes'] / mib, 2)):>9} "
+                f"{util:>6} " + " ".join(
+                    f"{_fmt(round(pools.get(p, 0) / mib, 2)):>9}"
+                    for p in ("serve", "train", "cohort", "scratch")))
     mrows = fleet_model_rows(reg, events)
     if mrows:
         out.append("")
@@ -651,6 +728,11 @@ def render(metrics_dir: str, reg: Registry, snaps, journals, events,
     if rows:
         out.append("-- derived throughput (per engine-second) --")
         out += rows
+        out.append("")
+
+    arbiter = render_arbiter(reg)
+    if arbiter:
+        out.append(arbiter)
         out.append("")
 
     learner = render_learner(reg, events)

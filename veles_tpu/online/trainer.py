@@ -96,87 +96,56 @@ class ShadowTrainer:
         import jax
         import jax.numpy as jnp
 
-        forwards = self.forwards
-        gds = self.gds
-        evaluator = self.evaluator
-        n_fwd = len(forwards)
-        first_gd = next((i for i, g in enumerate(gds)
-                         if g is not None), -1)
-        seed = self.seed
-        cd = batching.resolve_compute_dtype(None, self.device)
-        mixed = cd != jnp.float32
-        cast = batching.make_caster(cd)
+        from veles_tpu.engine import core as engine_core
 
-        def member_forward(cparams, x, rc, train):
-            h = x.astype(cd) if mixed else x
-            residuals = []
-            for i, f in enumerate(forwards):
-                rng = jax.random.fold_in(
-                    jax.random.fold_in(jax.random.key(seed), rc), i) \
-                    if f.stochastic else None
-                h, res = f.apply_fwd(cparams[f.name], h, rng=rng,
-                                     train=train)
-                residuals.append(res)
-            return h, residuals
+        evaluator = self.evaluator
+        cd = batching.resolve_compute_dtype(None, self.device)
+        cast = batching.make_caster(cd)
+        core = self._core = engine_core.ExecutionCore(
+            self.device, None, pool="train", name="online-shadow")
+        # the same shared Keel bodies the offline loops compose — the
+        # rng key chain hashes (model seed, step) identically to the
+        # oracle replay's, and the backward walk is the rates-only
+        # (wd=None) spelling the single-model loops use
+        forward_pass = engine_core.build_forward(self.forwards,
+                                                 self.seed, cd)
+        backward_update = engine_core.build_backward(self.forwards,
+                                                     self.gds, cd)
+        member_fwd = engine_core.build_member_forward(self.forwards,
+                                                      cd)
 
         def member_step(params, opt, lr, x, labels, mask, rc):
             # the fused train body, one micro-batch per dispatch —
             # vmap lifts it over the leading member axis of params/opt
             cparams = cast(params)
-            out, residuals = member_forward(cparams, x, rc, True)
+            out, residuals = forward_pass(cparams, x, rc, True)
             m = evaluator.metrics_fn(out.astype(jnp.float32), labels,
                                      mask)
             err = m["err_output"]
-            if mixed:
-                err = err.astype(cd)
-            new_params = dict(params)
-            new_opt = dict(opt)
-            for i in range(n_fwd - 1, -1, -1):
-                f, gd = forwards[i], gds[i]
-                if gd is None:
-                    continue
-                if i == first_gd and gd.can_skip_err_input:
-                    _, grads = gd.backward_from_saved(
-                        cparams[f.name], residuals[i], err,
-                        need_err_input=False)
-                    err_in = None
-                else:
-                    err_in, grads = gd.backward_from_saved(
-                        cparams[f.name], residuals[i], err)
-                if grads:
-                    p, v = gd.update_params(params[f.name], grads,
-                                            opt.get(gd.name, {}),
-                                            rates=(lr[i, 0],
-                                                   lr[i, 1]))
-                    new_params[f.name] = p
-                    if gd.name in opt:
-                        new_opt[gd.name] = v
-                err = err_in
+            new_params, new_opt = backward_update(
+                cparams, params, opt, residuals, err, lr)
             metrics = jnp.stack([m["n_err"], m["loss_sum"],
                                  m["count"]])
             return new_params, new_opt, metrics
 
-        self._step = jax.jit(
-            jax.vmap(member_step,
-                     in_axes=(0, 0, None, None, None, None, None)),
-            donate_argnums=(0, 1))
+        self._step = core.jit(
+            core.vmap_members(member_step,
+                              in_axes=(0, 0, None, None, None, None,
+                                       None)),
+            donate=(0, 1))
 
         def score(params, acc, x, labels, mask):
-            def fwd(p, h):
-                if mixed:
-                    h = h.astype(cd)
-                for f in forwards:
-                    h, _ = f.apply_fwd(p[f.name], h, rng=None,
-                                       train=False)
-                return h.astype(jnp.float32)
-
-            probs = jax.vmap(fwd, in_axes=(0, None))(cast(params), x)
+            probs = jax.vmap(member_fwd, in_axes=(0, None))(
+                cast(params), x)
+            # jnp.mean here is the shadow gate's own pinned oracle
+            # contract (tests/test_online.py) — NOT the serving
+            # dispatcher's fixed add chain; do not unify them
             pred = jnp.argmax(jnp.mean(probs, axis=0), axis=-1)
             wrong = jnp.sum((pred != labels).astype(jnp.float32)
                             * mask)
             return acc + jnp.stack([wrong, jnp.sum(mask)])
 
-        self._score = jax.jit(score, donate_argnums=(1,))
+        self._score = core.jit(score, donate=(1,))
 
     # -- the two dispatch kinds ---------------------------------------
 
@@ -338,7 +307,8 @@ class OnlineLearner(Logger):
         self.tap.arm(name, buf)
         # the shadow's stacked params + the buffer's host bytes are
         # real residency cost: charge them so the LRU budget sees them
-        self.residency.reserve(f"{name}@shadow", m.param_bytes)
+        self.residency.reserve(f"{name}@shadow", m.param_bytes,
+                               pool="train")
         telemetry.event(events.EV_ONLINE_ARMED, model=name,
                         members=trainer.n_members,
                         micro_batch=self.micro_batch,
@@ -516,7 +486,8 @@ class OnlineLearner(Logger):
                         buf: ReplayBuffer,
                         gate: PromotionGate) -> None:
         nbytes = buf.nbytes
-        self.residency.reserve(f"{name}@buffer", nbytes)
+        self.residency.reserve(f"{name}@buffer", nbytes,
+                               pool="scratch")
         telemetry.gauge(events.GAUGE_ONLINE_BUFFER_ROWS).set(
             buf.train_rows + buf.holdout_rows)
         telemetry.gauge(events.GAUGE_ONLINE_BUFFER_BYTES).set(nbytes)

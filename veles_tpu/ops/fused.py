@@ -66,6 +66,12 @@ class FusedStepRunner(AcceleratedUnit):
         self.data_sharded = False
         self._train_step = None
         self._eval_step = None
+        #: the Keel ExecutionCore (engine/core.py): every placement /
+        #: donation / compile decision this runner makes goes through
+        #: it, and it charges the params+opt footprint to the process
+        #: HBM arbiter's `train` pool.  Built with the steps (the mesh
+        #: must be resolved first).
+        self._core = None
         self._params: Optional[Dict[str, Dict[str, Any]]] = None
         self._opt: Optional[Dict[str, Dict[str, Any]]] = None
         self._rng_counter = 0
@@ -120,7 +126,7 @@ class FusedStepRunner(AcceleratedUnit):
 
     _unpicklable = AcceleratedUnit._unpicklable + (
         "_train_step", "_eval_step", "_params", "_opt", "mesh",
-        "_batch_sharding", "_acc", "_conf", "_inflight")
+        "_batch_sharding", "_acc", "_conf", "_inflight", "_core")
 
     @property
     def stream_transfer_bytes(self) -> int:
@@ -183,52 +189,33 @@ class FusedStepRunner(AcceleratedUnit):
                                               self.device)
 
     def _build_steps(self) -> None:
-        import jax
         import jax.numpy as jnp
         from jax import lax
+
+        from veles_tpu.engine import core as engine_core
 
         forwards = list(self.forwards)
         gds = list(self.gds)
         evaluator = self.evaluator
-        n_fwd = len(forwards)
-        first_gd = next((i for i, g in enumerate(gds) if g is not None),
-                        -1)
         want_confusion = self._want_confusion()
         seed = prng.get(self.rng_stream).seed
         cd = self._resolved_dtype()
-        mixed = cd != jnp.float32
         out_shape = tuple(forwards[-1].output.shape)
         streaming = self.streaming
-        dq = getattr(self.loader, "dequant", None)
-        if dq is not None:
-            # quantized ingest: batch rows arrive as uint8 (from the
-            # HBM-resident store or the streaming wire) and the affine
-            # dequantize+normalize runs HERE, as the traced prologue —
-            # f32 arithmetic first (host normalization order), then
-            # forward_pass casts to the compute dtype as usual
-            q_scale = jnp.asarray(dq.scale, jnp.float32)
-            q_bias = jnp.asarray(dq.bias, jnp.float32)
-
-        def ingest(x):
-            if dq is None:
-                return x
-            return x.astype(jnp.float32) * q_scale + q_bias
+        if self._core is not None:    # invalidate_trace rebuild: the
+            self._core.release()      # old ledger entry must not leak
+        core = self._core = engine_core.ExecutionCore(
+            self.device, self.mesh, pool="train", name=self.name)
+        # the shared Keel trace bodies: quantized wire ingest, the
+        # forward chain with residuals, and the backward+SGD walk —
+        # composing them here traces the identical jaxpr the
+        # pre-refactor loop did (parity pinned by test_engine_core)
+        ingest = engine_core.build_ingest(
+            getattr(self.loader, "dequant", None))
+        forward_pass = engine_core.build_forward(forwards, seed, cd)
+        backward_update = engine_core.build_backward(forwards, gds, cd)
 
         cast = batching.make_caster(cd)
-
-        def forward_pass(params, x, rng_counter, train: bool):
-            residuals = []
-            if mixed:
-                x = x.astype(cd)
-            for i, f in enumerate(forwards):
-                rng = jax.random.fold_in(
-                    jax.random.fold_in(jax.random.key(seed),
-                                       rng_counter), i) \
-                    if f.stochastic else None
-                x, res = f.apply_fwd(params[f.name], x, rng=rng,
-                                     train=train)
-                residuals.append(res)
-            return x, residuals
 
         def metrics_of(out, target, mask):
             m = evaluator.metrics_fn(out.astype(jnp.float32), target,
@@ -248,11 +235,8 @@ class FusedStepRunner(AcceleratedUnit):
             # re-enters the SAME batch sharding the replicated-data
             # path uses — identical downstream program, so residency
             # placement cannot change the numerics
-            import jax.sharding as _shd
             sharded_gather = batching.make_sharded_row_gather(self.mesh)
-            _mb_rows = _shd.NamedSharding(
-                self.mesh,
-                _shd.PartitionSpec(self.mesh.axis_names[0]))
+            _mb_rows = core.row_sharding
 
         def gather(dataset, target_store, indices):
             if data_sharded:
@@ -289,34 +273,8 @@ class FusedStepRunner(AcceleratedUnit):
                 out, residuals = forward_pass(cparams, x, rc, True)
                 m = metrics_of(out, target, mask)
                 err = m.pop("err_output")
-                if mixed:
-                    err = err.astype(cd)
-                new_params = dict(params)
-                new_opt = dict(opt)
-                for i in range(n_fwd - 1, -1, -1):
-                    f, gd = forwards[i], gds[i]
-                    if gd is None:
-                        continue
-                    if i == first_gd and gd.can_skip_err_input:
-                        # nothing consumes the chain-head err_input;
-                        # for conv1 this skips the input-dilated
-                        # transposed conv (the worst MXU op here)
-                        _, grads = gd.backward_from_saved(
-                            cparams[f.name], residuals[i], err,
-                            need_err_input=False)
-                        err_in = None
-                    else:
-                        err_in, grads = gd.backward_from_saved(
-                            cparams[f.name], residuals[i], err)
-                    if grads:
-                        p, v = gd.update_params(params[f.name], grads,
-                                                opt.get(gd.name, {}),
-                                                rates=(lr[i, 0],
-                                                       lr[i, 1]))
-                        new_params[f.name] = p
-                        if gd.name in opt:
-                            new_opt[gd.name] = v
-                    err = err_in
+                new_params, new_opt = backward_update(
+                    cparams, params, opt, residuals, err, lr)
                 acc, conf = accumulate(acc, conf, m)
                 return (new_params, new_opt, acc, conf, rc + 1), None
             return body
@@ -392,49 +350,41 @@ class FusedStepRunner(AcceleratedUnit):
             # per-param batch reductions cross the sharded axis, so the
             # partitioner emits the gradient allreduce (ICI psum) —
             # this IS the master-slave aggregation, in-compiler.
-            import jax.sharding as shd
-            from veles_tpu.parallel.mesh import replicated_sharding
-            repl = replicated_sharding(self.mesh)
-            # superstep batches are (k, mb, ...): shard the MINIBATCH
-            # axis (streaming batch rows ride the same sharding — each
-            # device receives only its slice of every minibatch)
-            batch = self._batch_sharding = shd.NamedSharding(
-                self.mesh,
-                shd.PartitionSpec(None, self.mesh.axis_names[0]))
+            repl = core.replicated
+            # streaming batch rows ride the batch sharding — each
+            # device receives only its slice of every minibatch
+            batch = self._batch_sharding = core.batch_sharding
             if streaming:
-                self._train_step = jax.jit(
-                    train_step_stream, donate_argnums=(0, 1, 2, 3),
+                self._train_step = core.jit(
+                    train_step_stream, donate=(0, 1, 2, 3),
                     in_shardings=(repl, repl, repl, repl, batch,
                                   batch, batch, repl, repl))
-                self._eval_step = jax.jit(
-                    eval_step_stream, donate_argnums=(1, 2),
+                self._eval_step = core.jit(
+                    eval_step_stream, donate=(1, 2),
                     in_shardings=(repl, repl, repl, batch, batch,
                                   batch, repl))
             else:
                 # the resident store enters row-sharded under Lattice
                 # (1/N rows per device), replicated otherwise — the
                 # ONLY in_sharding difference between the two modes
-                store = shd.NamedSharding(
-                    self.mesh,
-                    shd.PartitionSpec(self.mesh.axis_names[0])) \
-                    if data_sharded else repl
-                self._train_step = jax.jit(
-                    train_step, donate_argnums=(0, 1, 2, 3),
+                store = core.row_sharding if data_sharded else repl
+                self._train_step = core.jit(
+                    train_step, donate=(0, 1, 2, 3),
                     in_shardings=(repl, repl, repl, repl, store, store,
                                   batch, batch, repl, repl))
-                self._eval_step = jax.jit(
-                    eval_step, donate_argnums=(1, 2),
+                self._eval_step = core.jit(
+                    eval_step, donate=(1, 2),
                     in_shardings=(repl, repl, repl, store, store,
                                   batch, batch, repl))
         elif streaming:
-            self._train_step = jax.jit(train_step_stream,
-                                       donate_argnums=(0, 1, 2, 3))
-            self._eval_step = jax.jit(eval_step_stream,
-                                      donate_argnums=(1, 2))
+            self._train_step = core.jit(train_step_stream,
+                                        donate=(0, 1, 2, 3))
+            self._eval_step = core.jit(eval_step_stream,
+                                       donate=(1, 2))
         else:
-            self._train_step = jax.jit(train_step,
-                                       donate_argnums=(0, 1, 2, 3))
-            self._eval_step = jax.jit(eval_step, donate_argnums=(1, 2))
+            self._train_step = core.jit(train_step,
+                                        donate=(0, 1, 2, 3))
+            self._eval_step = core.jit(eval_step, donate=(1, 2))
 
     # -- lifecycle -----------------------------------------------------
 
@@ -560,9 +510,8 @@ class FusedStepRunner(AcceleratedUnit):
             # Vectors upload replicated (MeshJaxDevice.put); the batch
             # args must enter the step sharded over the data axis —
             # replicated->sharded is a local slice, no communication.
-            import jax
-            indices = jax.device_put(indices, self._batch_sharding)
-            mask = jax.device_put(mask, self._batch_sharding)
+            indices = self._core.put(indices, self._batch_sharding)
+            mask = self._core.put(mask, self._batch_sharding)
         if train:
             self._params, self._opt, self._acc, self._conf = \
                 self._train_step(
@@ -587,7 +536,6 @@ class FusedStepRunner(AcceleratedUnit):
         falls behind the host (or a slow tunnel that falls behind the
         dispatch loop) back-pressures the loop instead of piling
         unsent host batches into RAM without bound."""
-        import jax
         import time
         xb = ld.superstep_data
         tb = ld.superstep_targets if self._has_targets() \
@@ -615,8 +563,8 @@ class FusedStepRunner(AcceleratedUnit):
                     raise RuntimeError(
                         "RESOURCE_EXHAUSTED: fault-injected OOM on "
                         "the streaming upload")
-                xb_dev = jax.device_put(xb, dst)
-                tb_dev = jax.device_put(tb, dst)
+                xb_dev = self._core.put(xb, dst)
+                tb_dev = self._core.put(tb, dst)
                 break
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -639,7 +587,7 @@ class FusedStepRunner(AcceleratedUnit):
                         buf.block_until_ready()
         xb, tb = xb_dev, tb_dev
         if self.mesh is not None:
-            mask = jax.device_put(mask, self._batch_sharding)
+            mask = self._core.put(mask, self._batch_sharding)
         self._inflight.append((xb, tb))
         if len(self._inflight) > 2:
             for buf in self._inflight.popleft():
@@ -766,6 +714,8 @@ class FusedStepRunner(AcceleratedUnit):
         self._params = self._opt = None
         self._acc = self._conf = None
         self._inflight.clear()
+        if self._core is not None:
+            self._core.release()
         for f in self.forwards:
             for v in f.param_vectors().values():
                 if v:
@@ -795,6 +745,12 @@ class FusedStepRunner(AcceleratedUnit):
         if self._params is None:
             self._params = self._collect_params()
             self._opt = self._collect_opt()
+            if self._core is not None:
+                # params + optimizer velocities are this runner's HBM
+                # footprint: ledger it in the arbiter's train pool
+                from veles_tpu.engine.core import tree_nbytes
+                self._core.charge(tree_nbytes(self._params)
+                                  + tree_nbytes(self._opt))
 
     def host_params(self) -> Dict[str, Dict[str, np.ndarray]]:
         """Current parameters as host numpy arrays (slave -> diff)."""
@@ -876,6 +832,7 @@ class FusedStepRunner(AcceleratedUnit):
         from collections import deque
         if self.__dict__.get("_inflight") is None:  # dropped by pickle
             self._inflight = deque()
+        self.__dict__.setdefault("_core", None)
 
 
 class EnsembleEvalEngine:
@@ -934,6 +891,13 @@ class EnsembleEvalEngine:
         #: an over-one-device's-budget ensemble serves RESIDENT at
         #: padded/N bytes per device instead of LRU-spilling
         self.member_sharded = bool(shard_members)
+        from veles_tpu.engine import core as engine_core
+        #: the Keel core: placement + compile seam (the arbiter charge
+        #: for served models stays with ResidencyManager, which owns
+        #: the admission decision — pool `serve` is ITS ledger row)
+        self._core = engine_core.ExecutionCore(
+            device, mesh if self.member_sharded else None,
+            pool="serve")
         if self.member_sharded:
             n = int(mesh.devices.size)
             pad = (-(-self.n_members // n) * n) - self.n_members
@@ -991,56 +955,22 @@ class EnsembleEvalEngine:
         """Member-sharded stacked-param upload: P_pad/N members per
         device through the ONE sharding seam, charging the padded
         total once (not xN like a replicated put)."""
-        from veles_tpu.parallel import mesh as mesh_helpers
-        buf = mesh_helpers.put_member_sharded(self.device.mesh, array)
-        self.device.h2d_bytes += int(buf.nbytes)
-        return buf
+        return self._core.put_members(array)
 
     def _build(self) -> None:
-        import jax
         import jax.numpy as jnp
 
-        forwards = self.forwards
+        from veles_tpu.engine import core as engine_core
+
         cd = self._resolved_dtype()
-        mixed = cd != jnp.float32
-        cast = batching.make_caster(cd)
-        n_members = self.n_members
-        if self.member_sharded:
-            from veles_tpu.parallel import mesh as mesh_helpers
-            replicated = mesh_helpers.replicated_sharding(
-                self.device.mesh)
-        else:
-            replicated = None
-
-        def member_forward(params, x):
-            # ONE member's pure inference chain — the same apply_fwd
-            # path the fused eval step traces; vmap lifts it over the
-            # stacked member axis of ``params`` with x broadcast
-            if mixed:
-                x = x.astype(cd)
-            for f in forwards:
-                x, _ = f.apply_fwd(params[f.name], x, rng=None,
-                                   train=False)
-            return x.astype(jnp.float32)
-
-        def mean_probs(params, x):
-            probs = jax.vmap(member_forward, in_axes=(0, None))(
-                cast(params), x)
-            # the member average is a FIXED left-to-right add chain
-            # over the real members (never the mesh-padding copies),
-            # not jnp.mean: XLA may re-associate a reduce differently
-            # between the sharded and unsharded programs, and serving
-            # parity across placements is pinned f32-exact.  On a
-            # mesh the constraint gathers the member axis first
-            # (all_gather moves bits, bitwise), so both programs run
-            # the identical chain on identical values.
-            if replicated is not None:
-                probs = jax.lax.with_sharding_constraint(
-                    probs, replicated)
-            acc = probs[0]
-            for i in range(1, n_members):
-                acc = acc + probs[i]
-            return acc / n_members
+        # the shared Keel body: vmapped member forward + the
+        # fixed-order f32 member average (bitwise across placements —
+        # on a mesh the replicated constraint all_gathers the member
+        # axis first, so both programs run the identical add chain)
+        mean_probs = engine_core.build_mean_probs(
+            self.forwards, self.n_members, cd,
+            replicated=self._core.replicated
+            if self.member_sharded else None)
 
         def score(params, acc, x, labels, mask):
             p = mean_probs(params, x)
@@ -1048,8 +978,8 @@ class EnsembleEvalEngine:
             wrong = jnp.sum((pred != labels).astype(jnp.float32) * mask)
             return acc + jnp.stack([wrong, jnp.sum(mask)])
 
-        self._predict = jax.jit(mean_probs)
-        self._score = jax.jit(score, donate_argnums=(1,))
+        self._predict = self._core.jit(mean_probs)
+        self._score = self._core.jit(score, donate=(1,))
         self._mean_probs = mean_probs
         self._score_fn = score
         self._build_resident(sharded=False)
@@ -1061,7 +991,6 @@ class EnsembleEvalEngine:
         (batching.make_sharded_row_gather) — each device holds 1/N of
         the attached rows and the assembled minibatch is f32-exact vs
         the replicated gather, so scoring parity is bitwise."""
-        import jax
         import jax.numpy as jnp
 
         mean_probs, score = self._mean_probs, self._score_fn
@@ -1082,9 +1011,9 @@ class EnsembleEvalEngine:
             return score(params, acc, x, labels, mask)
 
         self._dataset_sharded = sharded
-        self._predict_resident = jax.jit(predict_resident)
-        self._score_resident = jax.jit(score_resident,
-                                       donate_argnums=(1,))
+        self._predict_resident = self._core.jit(predict_resident)
+        self._score_resident = self._core.jit(score_resident,
+                                              donate=(1,))
 
     # -- streaming path ------------------------------------------------
 
@@ -1449,11 +1378,6 @@ class PopulationTrainEngine:
             raise ValueError(
                 "PopulationTrainEngine needs a jax device (TPU or "
                 "XLA:CPU); per-genome evaluation is the numpy path")
-        if fused.streaming or not getattr(fused.loader,
-                                          "device_resident", True):
-            raise ValueError(
-                "PopulationTrainEngine needs a device-resident "
-                "dataset (streaming loaders fall back to per-genome)")
         self.workflow = workflow
         self.fused = fused
         self.loader = fused.loader
@@ -1464,6 +1388,16 @@ class PopulationTrainEngine:
         self.lr_adjust = getattr(workflow, "lr_adjust", None)
         self.device = device
         self.compute_dtype = compute_dtype
+        #: True = the loader's dataset is not HBM-resident: the cohort
+        #: consumes the loader's host-assembled superstep batches
+        #: (per-firing uploads through the Keel seam, broadcast over
+        #: the member axis) instead of gathering from a resident
+        #: store.  This LIFTS the dataset-must-fit constraint — HBM
+        #: holds params x P plus two in-flight batches, never the
+        #: dataset — with fitness parity exact vs the resident path
+        #: (same rows, same order, same trace bodies).
+        self.streaming = bool(fused.streaming or not getattr(
+            fused.loader, "device_resident", True))
         rates = np.asarray(member_rates, np.float32)
         decays = np.asarray(member_decays, np.float32)
         n_gd = len(self.gds)
@@ -1486,9 +1420,11 @@ class PopulationTrainEngine:
                     == "never":
                 self.mesh = None
         self.member_sharded = self.mesh is not None
-        #: per-shape cached member-sharded zeros dispatchers — a fresh
-        #: jit per accumulator reset would retrace every class end
-        self._zeros_cache: Dict[Tuple[int, ...], Any] = {}
+        from veles_tpu.engine import core as engine_core
+        #: the Keel core: all member/replicated placement, donation,
+        #: and the cohort-pool arbiter charge route through it
+        self._core = engine_core.ExecutionCore(
+            device, self.mesh, pool="cohort")
         if self.member_sharded:
             n_dev = int(self.mesh.devices.size)
             (rates, decays), self._n_stacked = batching.pad_members(
@@ -1515,54 +1451,36 @@ class PopulationTrainEngine:
                                        + tuple(v.shape))
                 for k, v in gd.accumulated_grads.items()}
         self._acc = self._fresh_cohort_acc()
-        self._replicate = None
         self._rng_counter = 0
         self._la_iteration = 0
         self._train_step = None
         self._eval_step = None
         self._build()
+        # stacked params + velocities + decays are the cohort's whole
+        # HBM footprint (the dataset never stacks, and in streaming
+        # mode never even uploads): ledger it in the arbiter's
+        # cohort pool so GA pressure is visible next to serving's
+        self._core.charge(
+            engine_core.tree_nbytes(self._params)
+            + engine_core.tree_nbytes(self._opt)
+            + engine_core.tree_nbytes(self._wd))
 
     # -- member-axis placement (Lattice) ------------------------------
 
     def _put_members(self, array: np.ndarray):
         """Upload a member-axis-leading array: sharded P/N per device
         on a mesh, a plain device put otherwise."""
-        if not self.member_sharded:
-            return self.device.put(array)
-        from veles_tpu.parallel import mesh as mesh_helpers
-        import jax.sharding as shd
-        buf = mesh_helpers.put_along(
-            self.mesh, np.asarray(array),
-            shd.PartitionSpec(self.mesh.axis_names[0]))
-        self.device.h2d_bytes += int(buf.nbytes)
-        return buf
+        return self._core.put_members(array)
 
     def _put_replicated(self, array: np.ndarray):
         """Replicate a host array over the engine's mesh (dataset,
         targets, superstep indices/masks — multihost-safe placement),
         or hand it through untouched off-mesh (the single-device jit
         consumes host numpy directly, as before)."""
-        if not self.member_sharded:
-            return array
-        from veles_tpu.parallel import mesh as mesh_helpers
-        import jax.sharding as shd
-        return mesh_helpers.put_along(self.mesh, np.asarray(array),
-                                      shd.PartitionSpec())
+        return self._core.put_replicated(array)
 
     def _zeros_members(self, shape):
-        if not self.member_sharded:
-            return self.device.zeros(shape, np.float32)
-        key = tuple(int(s) for s in shape)
-        fn = self._zeros_cache.get(key)
-        if fn is None:
-            import jax
-            import jax.numpy as jnp
-            from veles_tpu.parallel.mesh import member_sharding
-            fn = jax.jit(
-                lambda: jnp.zeros(key, jnp.float32),
-                out_shardings=member_sharding(self.mesh))
-            self._zeros_cache[key] = fn
-        return fn()
+        return self._core.zeros_members(shape)
 
     def _fresh_cohort_acc(self):
         if not self.member_sharded:
@@ -1574,14 +1492,7 @@ class PopulationTrainEngine:
         member-sharded accumulator is first re-laid-out replicated (a
         fully-replicated global array is host-fetchable from every
         process — the multihost-safe materialization)."""
-        if self.member_sharded:
-            import jax
-            if self._replicate is None:
-                from veles_tpu.parallel.mesh import replicated_sharding
-                self._replicate = jax.jit(
-                    lambda a: a,
-                    out_shardings=replicated_sharding(self.mesh))
-            acc = self._replicate(acc)
+        acc = self._core.replicate_for_fetch(acc)
         return np.asarray(acc)[:self.n_members]
 
     # -- trace construction -------------------------------------------
@@ -1591,52 +1502,47 @@ class PopulationTrainEngine:
                                               self.device)
 
     def _build(self) -> None:
-        import jax
         import jax.numpy as jnp
         from jax import lax
 
-        forwards = self.forwards
-        gds = self.gds
+        from veles_tpu.engine import core as engine_core
+
         evaluator = self.evaluator
-        n_fwd = len(forwards)
-        first_gd = next((i for i, g in enumerate(gds) if g is not None),
-                        -1)
         seed = prng.get(self.fused.rng_stream).seed
         cd = self._resolved_dtype()
-        mixed = cd != jnp.float32
-        dq = getattr(self.loader, "dequant", None)
-        if dq is not None:
-            q_scale = jnp.asarray(dq.scale, jnp.float32)
-            q_bias = jnp.asarray(dq.bias, jnp.float32)
-
-        def ingest(x):
-            if dq is None:
-                return x
-            return x.astype(jnp.float32) * q_scale + q_bias
+        core = self._core
+        # the same shared Keel bodies FusedStepRunner composes —
+        # cohort members share the per-genome oracle's seed, so
+        # dropout masks match it (and each other) exactly; the
+        # backward walk takes the per-member decays row
+        ingest = engine_core.build_ingest(
+            getattr(self.loader, "dequant", None))
+        forward_pass = engine_core.build_forward(self.forwards, seed,
+                                                cd)
+        backward_update = engine_core.build_backward(self.forwards,
+                                                     self.gds, cd)
 
         cast = batching.make_caster(cd)
-
-        def forward_pass(params, x, rng_counter, train: bool):
-            # identical key chain to FusedStepRunner: cohort members
-            # share the per-genome oracle's seed, so dropout masks
-            # match it (and each other) exactly
-            residuals = []
-            if mixed:
-                x = x.astype(cd)
-            for i, f in enumerate(forwards):
-                rng = jax.random.fold_in(
-                    jax.random.fold_in(jax.random.key(seed),
-                                       rng_counter), i) \
-                    if f.stochastic else None
-                x, res = f.apply_fwd(params[f.name], x, rng=rng,
-                                     train=train)
-                residuals.append(res)
-            return x, residuals
 
         def metrics_of(out, target, mask):
             # no confusion matrix: the GA consumes n_err only
             return evaluator.metrics_fn(out.astype(jnp.float32),
                                         target, mask)
+
+        def train_iter(carry, x, target, msk, lrow, wd):
+            # one minibatch of one member's train scan — shared by the
+            # resident (gathered) and streaming (host-assembled) paths
+            params, opt, acc, rc = carry
+            x = ingest(x)
+            cparams = cast(params)
+            out, residuals = forward_pass(cparams, x, rc, True)
+            m = metrics_of(out, target, msk)
+            err = m.pop("err_output")
+            new_params, new_opt = backward_update(
+                cparams, params, opt, residuals, err, lrow, wd)
+            acc = acc + jnp.stack([m["n_err"], m["loss_sum"],
+                                   m["count"]])
+            return (new_params, new_opt, acc, rc + 1)
 
         def member_train(params, opt, acc, lr, wd, dataset,
                          target_store, indices, mask, rc0):
@@ -1644,48 +1550,37 @@ class PopulationTrainEngine:
             # fused train_step scans, with per-member (lr, wd) closed
             # in via vmapped arguments instead of unit attributes
             def body(carry, xs):
-                params, opt, acc, rc = carry
                 idx, msk, lrow = xs
                 x = jnp.take(dataset, idx, axis=0)
                 target = jnp.take(target_store, idx, axis=0)
-                x = ingest(x)
-                cparams = cast(params)
-                out, residuals = forward_pass(cparams, x, rc, True)
-                m = metrics_of(out, target, msk)
-                err = m.pop("err_output")
-                if mixed:
-                    err = err.astype(cd)
-                new_params = dict(params)
-                new_opt = dict(opt)
-                for i in range(n_fwd - 1, -1, -1):
-                    f, gd = forwards[i], gds[i]
-                    if gd is None:
-                        continue
-                    if i == first_gd and gd.can_skip_err_input:
-                        _, grads = gd.backward_from_saved(
-                            cparams[f.name], residuals[i], err,
-                            need_err_input=False)
-                        err_in = None
-                    else:
-                        err_in, grads = gd.backward_from_saved(
-                            cparams[f.name], residuals[i], err)
-                    if grads:
-                        p, v = gd.update_params(
-                            params[f.name], grads,
-                            opt.get(gd.name, {}),
-                            rates=(lrow[i, 0], lrow[i, 1]),
-                            decays=(wd[i, 0], wd[i, 1]))
-                        new_params[f.name] = p
-                        if gd.name in opt:
-                            new_opt[gd.name] = v
-                    err = err_in
-                acc = acc + jnp.stack([m["n_err"], m["loss_sum"],
-                                       m["count"]])
-                return (new_params, new_opt, acc, rc + 1), None
+                return train_iter(carry, x, target, msk, lrow, wd), \
+                    None
 
             (params, opt, acc, _), _ = lax.scan(
                 body, (params, opt, acc, rc0), (indices, mask, lr))
             return params, opt, acc
+
+        def member_train_stream(params, opt, acc, lr, wd, xb, tb,
+                                mask, rc0):
+            # the streaming-cohort scan: batch rows ride the scan
+            # directly (broadcast over the member axis by vmap — HBM
+            # holds ONE copy of each batch, params x P, zero dataset
+            # residency)
+            def body(carry, xs):
+                x, target, msk, lrow = xs
+                return train_iter(carry, x, target, msk, lrow, wd), \
+                    None
+
+            (params, opt, acc, _), _ = lax.scan(
+                body, (params, opt, acc, rc0), (xb, tb, mask, lr))
+            return params, opt, acc
+
+        def eval_iter(acc, cparams, x, target, msk, rc):
+            out, _ = forward_pass(cparams, ingest(x), rc, False)
+            m = metrics_of(out, target, msk)
+            m.pop("err_output")
+            return acc + jnp.stack([m["n_err"], m["loss_sum"],
+                                    m["count"]])
 
         def member_eval(params, acc, dataset, target_store, indices,
                         mask, rc0):
@@ -1696,30 +1591,54 @@ class PopulationTrainEngine:
                 idx, msk = xs
                 x = jnp.take(dataset, idx, axis=0)
                 target = jnp.take(target_store, idx, axis=0)
-                out, _ = forward_pass(cparams, ingest(x), rc, False)
-                m = metrics_of(out, target, msk)
-                m.pop("err_output")
-                acc = acc + jnp.stack([m["n_err"], m["loss_sum"],
-                                       m["count"]])
-                return (acc, rc + 1), None
+                return (eval_iter(acc, cparams, x, target, msk, rc),
+                        rc + 1), None
 
             (acc, _), _ = lax.scan(body, (acc, rc0), (indices, mask))
+            return acc
+
+        def member_eval_stream(params, acc, xb, tb, mask, rc0):
+            cparams = cast(params)
+
+            def body(carry, xs):
+                acc, rc = carry
+                x, target, msk = xs
+                return (eval_iter(acc, cparams, x, target, msk, rc),
+                        rc + 1), None
+
+            (acc, _), _ = lax.scan(body, (acc, rc0), (xb, tb, mask))
             return acc
 
         # member axis on params/opt/acc/lr/wd; dataset, targets,
         # indices, mask, rng_counter broadcast — x stays UNBATCHED
         # through gather+ingest (vmap only batches where member-axis
         # arrays flow in, i.e. from the first matmul on), so the
-        # cohort's HBM cost is params x P, not data x P
-        self._train_step = jax.jit(
-            jax.vmap(member_train,
-                     in_axes=(0, 0, 0, 0, 0, None, None, None, None,
-                              None)),
-            donate_argnums=(0, 1, 2))
-        self._eval_step = jax.jit(
-            jax.vmap(member_eval,
-                     in_axes=(0, 0, None, None, None, None, None)),
-            donate_argnums=(1,))
+        # cohort's HBM cost is params x P, not data x P.  The
+        # streaming variants broadcast the host-assembled batch the
+        # same way: one batch copy serves every member.
+        if self.streaming:
+            self._train_step = core.jit(
+                core.vmap_members(
+                    member_train_stream,
+                    in_axes=(0, 0, 0, 0, 0, None, None, None, None)),
+                donate=(0, 1, 2))
+            self._eval_step = core.jit(
+                core.vmap_members(
+                    member_eval_stream,
+                    in_axes=(0, 0, None, None, None, None)),
+                donate=(1,))
+        else:
+            self._train_step = core.jit(
+                core.vmap_members(
+                    member_train,
+                    in_axes=(0, 0, 0, 0, 0, None, None, None, None,
+                             None)),
+                donate=(0, 1, 2))
+            self._eval_step = core.jit(
+                core.vmap_members(
+                    member_eval,
+                    in_axes=(0, 0, None, None, None, None, None)),
+                donate=(1,))
 
     # -- per-member learning-rate schedule ----------------------------
 
@@ -1790,7 +1709,14 @@ class PopulationTrainEngine:
         min_valid_epoch = np.full(P, -1, np.int64)
         min_train = np.full(P, np.inf)
         complete = np.zeros(P, bool)
-        if self.member_sharded:
+        streaming = self.streaming
+        if streaming:
+            # streaming cohort: ZERO dataset residency — the only data
+            # on device is each firing's host-assembled superstep
+            # batch, broadcast across the member axis by the vmap (one
+            # copy serves every member)
+            dataset = targets = None
+        elif self.member_sharded:
             # the engine owns its data placement on the mesh: the
             # replicated copy lives next to the member-sharded stacks
             # regardless of which single device built the workflow
@@ -1808,18 +1734,39 @@ class PopulationTrainEngine:
             k = idxs.shape[0]
             klass = ld.minibatch_class
             if klass == TRAIN or klass == VALID:
-                idx_dev = self._put_replicated(idxs)
                 mask_dev = self._put_replicated(mask)
+                if streaming:
+                    xb = ld.superstep_data
+                    tb = ld.superstep_targets \
+                        if self.fused._has_targets() \
+                        else ld.superstep_labels
+                    if xb is None or tb is None:
+                        raise RuntimeError(
+                            "cohort streaming mode but the loader "
+                            "produced no superstep batch "
+                            "(superstep_data/targets)")
+                    xb_dev = self._put_replicated(xb)
+                    tb_dev = self._put_replicated(tb)
+                else:
+                    idx_dev = self._put_replicated(idxs)
             if klass == TRAIN:
-                params, opt, acc = self._train_step(
-                    params, opt, acc,
-                    self._put_members(self._member_lr(k)), self._wd,
-                    dataset, targets, idx_dev, mask_dev,
-                    self._rng_counter)
+                lr = self._put_members(self._member_lr(k))
+                if streaming:
+                    params, opt, acc = self._train_step(
+                        params, opt, acc, lr, self._wd, xb_dev,
+                        tb_dev, mask_dev, self._rng_counter)
+                else:
+                    params, opt, acc = self._train_step(
+                        params, opt, acc, lr, self._wd, dataset,
+                        targets, idx_dev, mask_dev, self._rng_counter)
             elif klass == VALID:
-                acc = self._eval_step(params, acc, dataset, targets,
-                                      idx_dev, mask_dev,
-                                      self._rng_counter)
+                if streaming:
+                    acc = self._eval_step(params, acc, xb_dev, tb_dev,
+                                          mask_dev, self._rng_counter)
+                else:
+                    acc = self._eval_step(params, acc, dataset,
+                                          targets, idx_dev, mask_dev,
+                                          self._rng_counter)
             # TEST firings never feed fitness: skip the dispatch but
             # keep the rng_counter advance so dropout streams stay
             # aligned with the oracle's firing count
@@ -1862,8 +1809,7 @@ class PopulationTrainEngine:
         self._acc = None
         self._wd = None
         self._train_step = self._eval_step = None
-        self._zeros_cache.clear()
-        self._replicate = None
+        self._core.release()
 
 
 #: back-compat alias — the chunk/pad helper moved to ops/batching.py

@@ -171,8 +171,9 @@ class KohonenTrainer(AcceleratedUnit):
             self.loss.reset(np.float32([qe]))
         else:
             if self._compiled is None:
-                self._compiled = self.device.compile(
-                    som_step, donate_argnums=(0,))
+                from veles_tpu.engine import core as engine_core
+                self._compiled = engine_core.donating_jit(
+                    som_step, donate=(0,))
             w, winners, qe = self._compiled(
                 f.weights.unmap(),
                 f.input.unmap().reshape(len(f.input), -1),

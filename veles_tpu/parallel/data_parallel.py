@@ -63,7 +63,8 @@ class MeshJaxDevice(JaxDevice):
         arr = np.array(array, copy=True)
         # replicated = one physical copy PER device
         self.h2d_bytes += arr.nbytes * self.n_devices
-        return self._jax.device_put(arr, self._repl)
+        from veles_tpu.engine import core as engine_core
+        return engine_core.put(arr, self._repl)
 
     def put_sharded(self, array) -> Any:
         """Upload with the leading axis split 1/N per device (rows

@@ -255,6 +255,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         device, budget_bytes=args.hbm_budget or None,
         max_batch=max(1, args.max_batch),
         max_wait_s=max(0.0, args.max_wait_ms) / 1000.0)
+    # this manager IS the process HBM arbiter: the online tier's
+    # shadow training and any in-process cohort work charge the same
+    # ledger the LRU spill reads
+    from veles_tpu.serve.residency import install_process_arbiter
+    install_process_arbiter(residency)
 
     pristine = copy.deepcopy(dict(root.__dict__))
     for name, path in specs:

@@ -32,11 +32,17 @@ spilling, and capacity scales with the mesh instead of replicating it
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from veles_tpu import events, knobs, telemetry
 from veles_tpu.analysis import witness
 from veles_tpu.logger import Logger
+
+#: the arbiter's ledger pools — every byte resident on the device is
+#: charged to exactly one: served model stacks (``serve``), training /
+#: online-shadow state (``train``), GA cohort stacks (``cohort``), and
+#: everything else (replay buffers, probes: ``scratch``)
+POOLS = ("serve", "train", "cohort", "scratch")
 
 
 class HostedModel:
@@ -85,8 +91,11 @@ class ResidencyManager(Logger):
         #: drain, compile, H2D upload) stays OUTSIDE this lock.
         self._lock = witness.lock("residency.state")
         #: side charges against the budget that are not stacked model
-        #: params: the online tier's shadow params + replay buffers
-        self.reserved: Dict[str, int] = {}
+        #: params — name -> (bytes, pool): the online tier's shadow
+        #: params + replay buffers, and (PR 18) every ExecutionCore's
+        #: footprint, so training, GA cohorts, and serving draw on ONE
+        #: ledger instead of per-subsystem budget fictions
+        self.reserved: Dict[str, Tuple[int, str]] = {}
         #: devices the replica's device owns (1 off-mesh): budgets are
         #: per device, so a member-sharded model charges padded/N here
         self.n_devices = int(getattr(device, "n_devices", 1))
@@ -101,6 +110,11 @@ class ResidencyManager(Logger):
         budget against one copy's bytes stays honest (the Lattice
         convention — capacity multiplies only for SHARDED placements,
         and served model params replicate)."""
+        unified = int(knobs.get(knobs.HBM_BUDGET))
+        if unified:
+            # the set-wins unified arbiter budget: one number for
+            # training, GA cohorts, and serving alike
+            return unified
         jdev = getattr(device, "jax_device", None)
         if jdev is not None:
             try:
@@ -122,14 +136,45 @@ class ResidencyManager(Logger):
                     f"duplicate model name {model.name!r}")
             self.models[model.name] = model
 
-    def reserve(self, name: str, nbytes: int) -> None:
+    def reserve(self, name: str, nbytes: int,
+                pool: str = "scratch") -> None:
         """Charge (or re-charge) a named side allocation against the
-        budget — the online tier's shadow params and replay-buffer
-        bytes stack on the model residency cost exactly like the
-        uint8 ingest charge stacks on the dataset budget."""
+        budget, tagged with its ledger ``pool`` — the online tier's
+        shadow params and replay-buffer bytes stack on the model
+        residency cost exactly like the uint8 ingest charge stacks on
+        the dataset budget; since PR 18 every ExecutionCore charges
+        its params/opt footprint here too, making this THE process
+        HBM ledger."""
+        if pool not in POOLS:
+            raise ValueError(f"unknown arbiter pool {pool!r} "
+                             f"(declared: {POOLS})")
         with self._lock:
-            self.reserved[name] = int(nbytes)
+            self.reserved[name] = (int(nbytes), pool)
         self._update_gauges()
+
+    def release(self, name: str) -> None:
+        """Drop a named charge (engine/core release path); unknown
+        names are a no-op — a release must never fail a teardown."""
+        with self._lock:
+            self.reserved.pop(name, None)
+        self._update_gauges()
+
+    def ledger(self) -> Dict[str, int]:
+        """Per-pool resident bytes — the arbiter's public read: the
+        ``serve`` pool carries the resident stacked models plus any
+        serve-tagged reserves; the other pools are pure reserve
+        sums.  Rendered by /api/metrics and the obs fleet view so an
+        over-budget reserve is visible BEFORE it OOMs."""
+        with self._lock:
+            reserved = list(self.reserved.values())
+            model_bytes = sum(self._charge(m)
+                              for m in self.models.values()
+                              if m.resident)
+        out = {pool: 0 for pool in POOLS}
+        out["serve"] = model_bytes
+        for nbytes, pool in reserved:
+            out[pool] += nbytes
+        return out
 
     # -- placement / charging ------------------------------------------
 
@@ -174,13 +219,27 @@ class ResidencyManager(Logger):
         # snapshot the dicts first: gauges read this from the main
         # loop while the scavenger re-charges its buffer reservation
         return sum(self._charge(m) for m in list(self.models.values())
-                   if m.resident) + sum(tuple(self.reserved.values()))
+                   if m.resident) + sum(
+            v[0] for v in tuple(self.reserved.values()))
 
     def resident_count(self) -> int:
         return sum(1 for m in list(self.models.values())
                    if m.resident)
 
     def _update_gauges(self) -> None:
+        led = self.ledger()
+        telemetry.gauge(events.GAUGE_ARBITER_BUDGET_BYTES).set(
+            self.budget_bytes)
+        telemetry.gauge(events.GAUGE_ARBITER_RESIDENT_BYTES).set(
+            sum(led.values()))
+        for pool, nbytes in led.items():
+            telemetry.gauge(
+                f"arbiter.pool.{pool}.resident_bytes").set(nbytes)
+        if not self.models:
+            # a training-only process arbiter: publishing serve.*
+            # gauges from it would pollute the obs fleet rows with a
+            # phantom zero-model replica
+            return
         telemetry.gauge(events.GAUGE_SERVE_MODELS_RESIDENT).set(
             self.resident_count())
         telemetry.gauge(events.GAUGE_SERVE_RESIDENT_BYTES).set(
@@ -360,3 +419,36 @@ class ResidencyManager(Logger):
             if m.engine is not None:
                 m.engine.release()
                 m.engine = None
+
+
+# -- the process-wide arbiter ------------------------------------------
+# ONE ResidencyManager per process is THE HBM arbiter: a hive installs
+# its (model-hosting) manager at startup, while a training/GA process
+# lazily gets a model-less one the first time an ExecutionCore charges
+# its footprint.  Either way the ledger() pools and the arbiter.*
+# gauges read the same single source of truth.
+
+_process_arbiter: Optional[ResidencyManager] = None
+_arbiter_lock = witness.lock("residency.arbiter")
+
+
+def install_process_arbiter(manager: ResidencyManager) \
+        -> ResidencyManager:
+    """Make ``manager`` THE process arbiter (the hive calls this with
+    its model-hosting manager before serving starts, so training
+    charges land on the ledger the LRU spill reads)."""
+    global _process_arbiter
+    with _arbiter_lock:
+        _process_arbiter = manager
+    return manager
+
+
+def process_arbiter(device: Any = None) -> ResidencyManager:
+    """The process-wide HBM arbiter, created on first use when no
+    hive installed one — a model-less manager whose reserve ledger
+    still budgets and gauges every ExecutionCore's footprint."""
+    global _process_arbiter
+    with _arbiter_lock:
+        if _process_arbiter is None:
+            _process_arbiter = ResidencyManager(device)
+        return _process_arbiter

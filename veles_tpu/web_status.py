@@ -102,12 +102,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _send_metrics_json(self) -> None:
-        from veles_tpu.obs import (fleet_model_rows, fleet_rows,
-                                   learner_rows, load_dir)
+        from veles_tpu.obs import (arbiter_ledger, fleet_model_rows,
+                                   fleet_rows, learner_rows, load_dir)
         reg, snaps, journals, events = load_dir(self.metrics_dir)
         merged = reg.snapshot()
         merged["snapshots"] = len(snaps)
         merged["journal_events"] = len(events)
+        arbiter = arbiter_ledger(reg)
+        if arbiter:
+            merged["arbiter"] = arbiter
         replicas = fleet_rows(self.metrics_dir)
         if replicas:
             merged["fleet"] = {
