@@ -933,6 +933,192 @@ def cohort_streaming_metric(phase):
         return None
 
 
+def _zoo_som_run(fused, epochs_timed, som_cfg):
+    """One Kohonen workflow driven loader->trainer for 1 warmup epoch
+    + ``epochs_timed`` timed epochs; returns (seconds, final weights,
+    post-warmup recompiles)."""
+    from veles_tpu import prng
+    from veles_tpu.backends import JaxDevice
+    from veles_tpu.models import kohonen as kmod
+
+    prng._streams.clear()
+    prng.seed_all(4242)
+    w = kmod.KohonenWorkflow(
+        loader_cfg=dict(som_cfg), som_shape=(8, 8),
+        trainer_cfg={"alpha0": 0.3, "alpha_min": 0.01,
+                     "decay_epochs": 8},
+        decision_cfg={"max_epochs": epochs_timed + 1},
+        name="ZooSomBench")
+    w.initialize(device=JaxDevice(platform="cpu"), fused=fused)
+    ld, tr = w.loader, w.trainer
+    while ld.epoch_number < 1:      # warmup: the one compile
+        ld.run()
+        tr.run()
+    np.asarray(w.forward.weights.map_read())   # sync barrier
+    caches = None
+    if fused:
+        caches = (tr._train_epoch._cache_size()
+                  + tr._eval_epoch._cache_size())
+    t0 = time.perf_counter()
+    while ld.epoch_number < 1 + epochs_timed:
+        ld.run()
+        tr.run()
+    wfinal = np.asarray(w.forward.weights.map_read())  # sync
+    dt = time.perf_counter() - t0
+    recompiles = 0
+    if fused:
+        recompiles = (tr._train_epoch._cache_size()
+                      + tr._eval_epoch._cache_size()) - caches
+    w.stop()
+    return dt, wfinal, recompiles
+
+
+def zoo_metric(phase):
+    """Menagerie (ISSUE 19): the zoo's long tail on the engine core,
+    measured on XLA:CPU (build box — dispatch/compile amortization is
+    the story; docs/perf.md reads the numbers honestly).
+
+    (a) SOM: one donated epoch scan (``engine_core.build_som_epoch``)
+        vs the eager per-minibatch dispatch loop — images/s both ways
+        over the SAME epochs after a warmup epoch each, final
+        prototypes f32-BITWISE equal, zero post-warmup recompiles;
+    (b) RBM: a CD-1 learning-rate cohort trained per-genome (P fused
+        workflow runs, each paying its own trace+compile) vs ONE
+        vmapped ``PopulationTrainEngine`` — genomes/s each, member
+        params checked against the per-genome runs;
+    (c) DBN: the greedy stage chain's inter-stage ``Device.h2d_bytes``
+        delta (the =0 pin) on a real two-stage pretrain.
+    """
+    if os.environ.get("BENCH_SKIP_ZOO"):
+        return None
+    try:
+        from veles_tpu.backends import JaxDevice
+
+        # -- (a) fused SOM epoch vs the eager oracle ---------------
+        som_cfg = {"minibatch_size": 32, "n_train": 6400,
+                   "n_valid": 0, "shape": (8, 8, 1), "n_classes": 8,
+                   "seed": 888}
+        epochs = 4
+        batches = -(-som_cfg["n_train"] // som_cfg["minibatch_size"])
+        phase(f"zoo: SOM {som_cfg['n_train']} rows x {epochs} epochs,"
+              f" eager oracle ({batches} dispatches/epoch)")
+        t_eager, w_eager, _ = _zoo_som_run(False, epochs, som_cfg)
+        phase(f"zoo: SOM eager "
+              f"{epochs * som_cfg['n_train'] / t_eager:.0f} images/s;"
+              f" fused epoch scan (1 dispatch/epoch)")
+        t_fused, w_fused, recompiles = _zoo_som_run(True, epochs,
+                                                    som_cfg)
+        som_bitwise = bool(np.array_equal(w_fused, w_eager))
+        phase(f"zoo: SOM fused "
+              f"{epochs * som_cfg['n_train'] / t_fused:.0f} images/s "
+              f"(bitwise={som_bitwise}, recompiles={recompiles})")
+
+        # -- (b) CD-1 RBM cohort vs per-genome runs ----------------
+        from veles_tpu import prng
+        from veles_tpu.loader.synthetic import MnistLoader
+        from veles_tpu.ops.fused import PopulationTrainEngine
+        from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+        lrs = [0.3, 0.1, 0.05, 0.8]
+
+        def build_rbm(lr):
+            prng._streams.clear()
+            prng.seed_all(1234)
+            w = StandardWorkflow(
+                loader_factory=lambda wf: MnistLoader(
+                    wf, name="loader", targets_from_data=True,
+                    minibatch_size=50, n_train=400, n_valid=100),
+                layers=[
+                    {"type": "binarization", "->": {}, "<-": {}},
+                    {"type": "rbm", "->": {"n_hidden": 32},
+                     "<-": {"learning_rate": lr,
+                            "gradient_moment": 0.5, "cd_k": 1}},
+                ],
+                loss_function="mse",
+                decision_config={"max_epochs": 3},
+                name="ZooRbmBench")
+            w.initialize(device=JaxDevice(platform="cpu"))
+            return w
+
+        phase(f"zoo: RBM CD-1 cohort, {len(lrs)} genomes per-genome "
+              f"(each pays its own trace+compile)")
+        t0 = time.perf_counter()
+        serial_params = []
+        for lr in lrs:
+            w = build_rbm(lr)
+            w.run()
+            serial_params.append(
+                {k: np.array(v.map_read()) for k, v in
+                 w.forwards[1].param_vectors().items()})
+            w.stop()
+        t_serial = time.perf_counter() - t0
+        phase(f"zoo: RBM serial {len(lrs) / t_serial:.2f} genomes/s; "
+              f"same genomes as ONE vmapped cohort")
+        t0 = time.perf_counter()
+        w = build_rbm(lrs[0])
+        rates = np.asarray([[[lr, lr]] * len(w.gds) for lr in lrs],
+                           np.float32)
+        engine = PopulationTrainEngine(w, rates,
+                                       np.zeros_like(rates))
+        engine.run()
+        stacked = engine._params[w.forwards[1].name]
+        rbm_diff = 0.0
+        for i, want in enumerate(serial_params):
+            for pn, arr in want.items():
+                rbm_diff = max(rbm_diff, float(np.max(np.abs(
+                    np.asarray(stacked[pn][i]) - arr))))
+        engine.release()
+        w.stop()
+        t_batched = time.perf_counter() - t0
+        phase(f"zoo: RBM cohort {len(lrs) / t_batched:.2f} genomes/s "
+              f"(param max |diff| vs per-genome: {rbm_diff})")
+
+        # -- (c) DBN on-device stage chain -------------------------
+        from veles_tpu.models import mnist_dbn
+        prng.seed_all(7)
+        stats = {}
+        phase("zoo: DBN 2-stage greedy pretrain (device chain)")
+        mnist_dbn.pretrain(
+            device=JaxDevice(platform="cpu"),
+            loader_cfg={"minibatch_size": 50, "n_train": 400,
+                        "n_valid": 100},
+            hidden=[32, 16], epochs=2, stats=stats)
+        phase(f"zoo: DBN device_chain={stats['device_chain']} "
+              f"interstage_h2d_bytes="
+              f"{stats['interstage_h2d_bytes']}")
+
+        return {
+            "zoo_som_rows": som_cfg["n_train"],
+            "zoo_som_epochs_timed": epochs,
+            "zoo_som_dispatches_per_epoch_eager": batches,
+            "zoo_som_dispatches_per_epoch_fused": 1,
+            "zoo_som_images_per_sec_eager": round(
+                epochs * som_cfg["n_train"] / t_eager, 1),
+            "zoo_som_images_per_sec_fused": round(
+                epochs * som_cfg["n_train"] / t_fused, 1),
+            "zoo_som_fused_speedup_x": round(t_eager / t_fused, 2),
+            "zoo_som_parity_bitwise": som_bitwise,
+            "zoo_som_recompiles_post_warmup": int(recompiles),
+            "zoo_rbm_cohort_size": len(lrs),
+            "zoo_rbm_genomes_per_sec_serial": round(
+                len(lrs) / t_serial, 3),
+            "zoo_rbm_genomes_per_sec_batched": round(
+                len(lrs) / t_batched, 3),
+            "zoo_rbm_cohort_speedup_x": round(
+                t_serial / t_batched, 2),
+            "zoo_rbm_param_max_abs_diff": rbm_diff,
+            "zoo_dbn_device_chain": bool(stats["device_chain"]),
+            "zoo_dbn_interstage_h2d_bytes": int(
+                stats["interstage_h2d_bytes"]),
+            "zoo_dbn_stage_rows": [s["rows"]
+                                   for s in stats["stages"]],
+            "zoo_platform": "cpu",
+        }
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"zoo metric failed: {e}", file=sys.stderr)
+        return None
+
+
 def _serve_hist_window(after, before):
     """Reconstruct the latency distribution of ONE measurement window
     from two cumulative histogram snapshots (bucket-wise subtraction;
@@ -2978,6 +3164,18 @@ def main() -> None:
         rec.update(cohort_streaming_metric(_phase) or {})
         print(json.dumps(rec or None), flush=True)
         return
+    if "--zoo-only" in sys.argv:
+        # fast path: ONLY the Menagerie zoo phase (XLA:CPU,
+        # in-process) — the ISSUE 19 acceptance gate (fused SOM epoch
+        # vs eager, CD-1 cohort vs serial, DBN inter-stage bytes)
+        # without the headline build
+        t0 = time.perf_counter()
+
+        def _phase(msg):
+            print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}",
+                  file=sys.stderr, flush=True)
+        print(json.dumps(zoo_metric(_phase)), flush=True)
+        return
     if "--trace-only" in sys.argv:
         # fast path: ONLY the Flightline tracing phase (one XLA:CPU
         # replica) — the ISSUE 16 acceptance gate (tracing-on p99 <=
@@ -3333,6 +3531,12 @@ def main() -> None:
     cs = cohort_streaming_metric(phase)
     if cs:
         record.update(cs)
+    emit()
+
+    phase("measuring the zoo long tail (Menagerie: SOM/RBM/DBN)")
+    zoo = zoo_metric(phase)
+    if zoo:
+        record.update(zoo)
     emit()
 
     phase("measuring online serving (Hive, XLA:CPU subprocess)")

@@ -278,3 +278,71 @@ class TestPoolCohortParity:
         assert "cohorts:" in res.stderr      # the batched path ran
         out = json.loads(res.stdout.strip().splitlines()[-1])
         assert np.isfinite(out["fitness"])
+
+
+class TestRbmCohortParity:
+    """The zoo's long tail through the SAME engine (Menagerie): a CD-k
+    RBM learning-rate cohort trains as ONE vmapped
+    PopulationTrainEngine dispatch chain, and every member's trained
+    params match a per-genome fused oracle run — the CD sampling
+    draws ride the shared (seed, step) PRNG contract, so stochastic
+    layers batch without drifting.  On a single-device backend the
+    match is f32-bitwise; under the suite's 8-virtual-device XLA
+    config vmap picks different matmul fusions, so the pin here is
+    ulp-tight allclose (the SAME tolerance story as the SOM cohort,
+    tests/test_zoo_fused.py)."""
+
+    LCFG = {"minibatch_size": 50, "n_train": 200, "n_valid": 50}
+    LRS = [0.3, 0.05, 0.8]
+
+    def build(self, lr, cd_k):
+        from veles_tpu.backends import JaxDevice
+        from veles_tpu.loader.synthetic import MnistLoader
+        from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+        prng._streams.clear()
+        prng.seed_all(1234)
+        w = StandardWorkflow(
+            loader_factory=lambda wf: MnistLoader(
+                wf, name="loader", targets_from_data=True,
+                **self.LCFG),
+            layers=[
+                {"type": "binarization", "->": {}, "<-": {}},
+                {"type": "rbm", "->": {"n_hidden": 16},
+                 "<-": {"learning_rate": lr, "gradient_moment": 0.5,
+                        "cd_k": cd_k}},
+            ],
+            loss_function="mse",
+            decision_config={"max_epochs": 2},
+            name="RbmCohortWf")
+        w.initialize(device=JaxDevice(platform="cpu"))
+        return w
+
+    @pytest.mark.parametrize("cd_k", [1, 2])
+    def test_member_params_bitwise_vs_per_genome_oracle(self, cd_k):
+        from veles_tpu.ops.fused import PopulationTrainEngine
+
+        oracle = []
+        for lr in self.LRS:
+            w = self.build(lr, cd_k)
+            w.run()
+            oracle.append({k: np.array(v.map_read()) for k, v in
+                           w.forwards[1].param_vectors().items()})
+            w.stop()
+
+        w = self.build(self.LRS[0], cd_k)
+        n_gds = len(w.gds)
+        rates = np.asarray([[[lr, lr]] * n_gds for lr in self.LRS],
+                           np.float32)
+        decays = np.zeros_like(rates)
+        engine = PopulationTrainEngine(w, rates, decays)
+        engine.run()
+        stacked = engine._params[w.forwards[1].name]
+        for i, want in enumerate(oracle):
+            for pn, arr in want.items():
+                got = np.asarray(stacked[pn][i])
+                assert np.allclose(got, arr, rtol=1e-4, atol=5e-6), \
+                    (cd_k, i, pn,
+                     float(np.max(np.abs(got - arr))))
+        engine.release()
+        w.stop()

@@ -18,18 +18,20 @@ class TestHermeticDryrun:
     def test_dryrun_2_devices_in_process(self):
         """The contract call, in the CPU suite's own process (jax is
         already up on XLA:CPU with 8 virtual devices — the in-process
-        fast path).  All five mesh stages must execute: the
+        fast path).  All six mesh stages must execute: the
         data-parallel step, the row-sharded resident-gather step, the
         member-sharded population-cohort step (Lattice), the
-        member-sharded serve dispatch (Prism), and the Keel-unified
-        streaming-cohort → member-sharded-serving handoff."""
+        member-sharded serve dispatch (Prism), the Keel-unified
+        streaming-cohort → member-sharded-serving handoff, and the
+        member-sharded SOM cohort (Menagerie)."""
         from __graft_entry__ import dryrun_multichip
         stages = dryrun_multichip(2)
         assert stages == {"data_parallel": True,
                           "sharded_residency": True,
                           "member_sharded_cohort": True,
                           "member_sharded_serve": True,
-                          "keel_handoff": True}
+                          "keel_handoff": True,
+                          "member_sharded_som_cohort": True}
 
     def test_dryrun_pins_itself_with_noncpu_poisoned(self):
         """A fresh process with NO JAX_PLATFORMS pin from the caller

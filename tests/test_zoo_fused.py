@@ -1,0 +1,403 @@
+"""Menagerie (ISSUE 19): the zoo's long tail on the Keel core.
+
+The SOM epoch is ONE donated ``lax.scan`` built through the
+engine-core trace builders; the eager per-minibatch dispatch loop is
+the parity ORACLE (same masked step body, so fused-vs-eager pins
+f32-BITWISE, ragged final minibatch included).  SOM hyperparameter
+cohorts train population-batched (``SOMPopulationEngine``) against
+per-member fused oracle runs; the DBN's greedy stages chain ON DEVICE
+with the inter-stage host-transfer byte count pinned at zero (and
+bitwise-equal weights against an explicit host-round-trip oracle);
+the SOM serves through the unchanged Forge -> Hive surface and adopts
+GA cohort winners HBM-to-HBM through ``GAServingHandoff``.
+"""
+
+import copy
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from veles_tpu import events, prng, telemetry
+from veles_tpu.backends import JaxDevice
+from veles_tpu.models import kohonen as kmod
+from veles_tpu.models import mnist_dbn
+from veles_tpu.ops.kohonen import SOMPopulationEngine
+from veles_tpu.parallel import make_mesh
+
+# mb=50 over n_train=230: the final train minibatch is RAGGED (30
+# rows) — the scan pads it to the fixed shape and masks the padding
+# out of the update, so every parity pin below covers the ragged tail
+LCFG = {"minibatch_size": 50, "n_train": 230, "n_valid": 60,
+        "shape": (6, 6, 1), "n_classes": 5, "seed": 888}
+SOM_SHAPE = (5, 5)
+TCFG = {"alpha0": 0.3, "alpha_min": 0.01, "decay_epochs": 4}
+DCFG = {"max_epochs": 3}
+
+HP = np.array([
+    [0.3, 0.01, 2.5, 0.5],
+    [0.5, 0.05, 3.0, 0.8],
+    [0.1, 0.02, 1.5, 0.4],
+], np.float32)
+
+
+def build_som(fused=True, trainer_cfg=None, decision_cfg=None,
+              name="ZooSom"):
+    prng._streams.clear()
+    prng.seed_all(4242)
+    w = kmod.KohonenWorkflow(
+        loader_cfg=dict(LCFG), som_shape=SOM_SHAPE,
+        trainer_cfg=dict(trainer_cfg or TCFG),
+        decision_cfg=dict(decision_cfg or DCFG), name=name)
+    w.initialize(device=JaxDevice(platform="cpu"), fused=fused)
+    return w
+
+
+def _valid_losses(w):
+    return [r["loss"] for r in w.decision.history
+            if r["class"] == "validation"]
+
+
+class TestSomFusedParity:
+    """The fused epoch scan against the eager per-minibatch loop:
+    same masked step body, same per-step schedule, so the trained
+    prototypes are f32-BITWISE equal."""
+
+    def test_fused_matches_eager_f32_exact(self):
+        we = build_som(fused=False)
+        assert not we.trainer.fused
+        we.run()
+        eager_w = np.asarray(we.forward.weights.map_read())
+        eager_losses = _valid_losses(we)
+        we.stop()
+
+        wf = build_som(fused=True)
+        assert wf.trainer.fused
+        wf.run()
+        fused_w = np.asarray(wf.forward.weights.map_read())
+        fused_losses = _valid_losses(wf)
+        wf.stop()
+
+        assert np.array_equal(fused_w, eager_w)
+        # the per-epoch validation QE rides the same pin (the eval
+        # class runs through build_som_eval in the fused path)
+        assert len(fused_losses) == len(eager_losses) > 0
+        assert np.array_equal(np.float32(fused_losses),
+                              np.float32(eager_losses))
+
+    def test_fused_dispatch_count_is_per_class(self):
+        """One fused dispatch per (epoch, class) — the whole point:
+        the eager loop pays one dispatch per minibatch."""
+        c = telemetry.counter(events.CTR_SOM_FUSED_DISPATCHES)
+        before = c.value
+        w = build_som(fused=True)
+        w.run()
+        w.stop()
+        # max_epochs train firings + the interleaved validation
+        # firings (one each per epoch, plus the initial valid pass)
+        fired = c.value - before
+        n_batches = -(-LCFG["n_train"] // LCFG["minibatch_size"]) \
+            + -(-LCFG["n_valid"] // LCFG["minibatch_size"])
+        assert 0 < fired <= 2 * DCFG["max_epochs"] + 2
+        assert fired < DCFG["max_epochs"] * n_batches
+
+    def test_streaming_matches_resident_f32_exact(self):
+        """device_resident=False: the epoch scans host-assembled
+        superstep batches instead of gathering in-trace from the
+        resident store — same rows, same math, bitwise weights."""
+        wr = build_som(fused=True)
+        wr.run()
+        resident_w = np.asarray(wr.forward.weights.map_read())
+        wr.stop()
+
+        ws = build_som(fused=True)
+        ws.loader.device_resident = False
+        ws.run()
+        assert not ws.trainer._fused_resident
+        stream_w = np.asarray(ws.forward.weights.map_read())
+        ws.stop()
+        assert np.array_equal(stream_w, resident_w)
+
+    def test_no_post_warmup_recompiles(self):
+        """Epoch 1 compiles the train and eval scans once each; every
+        later epoch reuses the executables (ragged tails ride the
+        mask, the schedule rides the scan xs — neither retraces)."""
+        w = build_som(fused=True,
+                      decision_cfg={"max_epochs": 6})
+        w.run()
+        tr = w.trainer
+        assert tr._train_epoch._cache_size() == 1
+        assert tr._eval_epoch._cache_size() == 1
+        w.stop()
+
+
+class TestSomCohortParity:
+    """P hyperparameter genomes as ONE vmapped cohort vs P per-member
+    fused oracle runs (each member's fitness = its min per-epoch mean
+    validation QE, read off the oracle's decision history)."""
+
+    def _oracle(self):
+        fits = []
+        for a0, amin, s0, smin in HP:
+            w = build_som(
+                fused=True,
+                trainer_cfg={"alpha0": float(a0),
+                             "alpha_min": float(amin),
+                             "sigma0": float(s0),
+                             "sigma_min": float(smin),
+                             "decay_epochs": TCFG["decay_epochs"]},
+                name="ZooSomOracle")
+            w.run()
+            fits.append(min(_valid_losses(w)))
+            w.stop()
+        return np.asarray(fits)
+
+    def test_cohort_matches_per_member_oracle(self):
+        w = build_som(fused=True)
+        engine = SOMPopulationEngine(w, HP)
+        fits = engine.run()
+        engine.release()
+        w.stop()
+        oracle = self._oracle()
+        # vmap batching may refuse the oracle's exact matmul fusion
+        # on CPU XLA (observed: one f32 ulp on one member) — tight
+        # allclose, not bitwise
+        assert np.allclose(fits, oracle, rtol=1e-5, atol=0.0), \
+            (fits, oracle)
+
+    def test_padded_cohort_on_mesh_matches_unsharded(self):
+        """P=3 members on a 2-device mesh pad to 4 (member 0
+        repeated); per-member math never reduces across members, so
+        the REAL members' fitness is bitwise-independent of the
+        sharding."""
+        w = build_som(fused=True)
+        flat = SOMPopulationEngine(w, HP)
+        base = flat.run()
+        flat.release()
+        w.stop()
+
+        w = build_som(fused=True)
+        engine = SOMPopulationEngine(w, HP, mesh=make_mesh(2))
+        assert engine.member_sharded
+        assert engine._n_stacked == 4 and engine.n_members == 3
+        fits = engine.run()
+        assert fits.shape == (3,)
+        engine.release()
+        w.stop()
+        assert np.array_equal(fits, base), (fits, base)
+
+
+DBN_LOADER = {"minibatch_size": 25, "n_train": 200, "n_valid": 40}
+DBN_HIDDEN = [24, 12]
+
+
+class HostRoundTripLoader(mnist_dbn.DeviceArrayLoader):
+    """The oracle loader: same stage arrays, but forced through a
+    host d2h + h2d round trip (f32-lossless), so the byte counter
+    sees what the classic handoff pays while the MATH stays
+    identical to the device chain."""
+
+    def load_data(self):
+        before = int(self.device.h2d_bytes)
+        self._splits = {
+            k: (self.device.put(np.asarray(v)) if v is not None
+                else None)
+            for k, v in self._splits.items()}
+        super().load_data()
+        self.ingest_h2d_bytes = int(self.device.h2d_bytes) - before
+
+
+class TestDbnDeviceChain:
+    """Greedy DBN stages chain on device: stage k+1's hidden reps are
+    computed, sliced, and ingested without the dataset ever visiting
+    the host."""
+
+    def _pretrain(self, dev):
+        prng.seed_all(7)
+        stats = {}
+        out = mnist_dbn.pretrain(device=dev,
+                                 loader_cfg=dict(DBN_LOADER),
+                                 hidden=list(DBN_HIDDEN), epochs=2,
+                                 stats=stats)
+        return out, stats
+
+    def test_zero_interstage_host_bytes(self):
+        dev = JaxDevice(platform="cpu")
+        out, stats = self._pretrain(dev)
+        assert stats["device_chain"] is True
+        assert stats["interstage_h2d_bytes"] == 0
+        assert len(stats["stages"]) == len(DBN_HIDDEN) - 1
+        for st in stats["stages"]:
+            assert st["h2d_bytes"] == 0
+            # the stage dataset exists ONLY on device — the loader
+            # never materialized a host copy to upload from
+            assert st["host_free"] is True
+        assert out[1]["weights"].shape == tuple(DBN_HIDDEN)
+
+    def test_handoff_event_journaled(self):
+        dev = JaxDevice(platform="cpu")
+        self._pretrain(dev)
+        evs = telemetry.recent_events(events.EV_DBN_STAGE_HANDOFF)
+        assert evs and evs[-1]["h2d_bytes"] == 0
+        assert evs[-1]["rows"] == (DBN_LOADER["n_train"]
+                                   + DBN_LOADER["n_valid"])
+
+    def test_chain_matches_host_round_trip_oracle(self, monkeypatch):
+        """Routing the SAME stage arrays through an explicit host
+        round trip changes where the bytes flow — h2d goes positive —
+        and NOTHING else: every stage's weights stay f32-bitwise
+        equal.  The device chain is a pure byte-routing win."""
+        dev = JaxDevice(platform="cpu")
+        chained, _ = self._pretrain(dev)
+
+        monkeypatch.setattr(mnist_dbn, "DeviceArrayLoader",
+                            HostRoundTripLoader)
+        dev2 = JaxDevice(platform="cpu")
+        roundtrip, stats = self._pretrain(dev2)
+        assert stats["interstage_h2d_bytes"] > 0
+        for a, b in zip(chained, roundtrip):
+            assert np.array_equal(a["weights"], b["weights"])
+            assert np.array_equal(a["bias"], b["bias"])
+
+
+SOM_WF_TEXT = textwrap.dedent("""
+    from veles_tpu.models import kohonen
+
+    def create_workflow(launcher):
+        return kohonen.create_workflow(
+            launcher,
+            loader={"minibatch_size": 50, "n_train": 230,
+                    "n_valid": 60, "shape": (6, 6, 1),
+                    "n_classes": 5, "seed": 888},
+            som_shape=(5, 5),
+            trainer={"alpha0": 0.3, "alpha_min": 0.01,
+                     "decay_epochs": 4},
+            decision={"max_epochs": 1})
+""")
+
+
+def _som_package(d, name="zoo_som", n_members=2, seed=4242):
+    """One Forge SOM ensemble package + its host oracle members."""
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.ensemble.packaging import pack_ensemble
+    from veles_tpu.launcher import load_workflow_module
+
+    wf_path = os.path.join(d, "wf_som.py")
+    with open(wf_path, "w") as f:
+        f.write(SOM_WF_TEXT)
+    mod = load_workflow_module(wf_path)
+
+    class FL:
+        workflow = None
+
+    prng.seed_all(seed)
+    w = mod.create_workflow(FL())
+    w.initialize(device=NumpyDevice())
+    base = {w.forward.name: {
+        k: np.asarray(v) for k, v in w.forward.gather_params().items()}}
+    rng = np.random.default_rng(seed)
+    members = []
+    for _ in range(n_members):
+        params = {fn: {pn: (a + 0.05 * rng.standard_normal(a.shape)
+                            .astype(np.float32))
+                       for pn, a in p.items()}
+                  for fn, p in base.items()}
+        members.append({"params": params, "valid_error": 0.0,
+                        "seed": seed,
+                        "forward_names": [w.forward.name],
+                        "values": None})
+    pkg = os.path.join(d, f"{name}.vpkg")
+    pack_ensemble(pkg, name, members, wf_path)
+    return pkg, members, w
+
+
+class TestSomServing:
+    """The SOM through the unchanged Forge -> Hive surface: its
+    apply_fwd IS the serving op (the (B, N) squared-distance map;
+    clients read argmin winners and sqrt quantization errors), so
+    pack_ensemble / load_model_package / the batched engine need no
+    SOM-specific code."""
+
+    def test_forge_package_serves_winner_and_qe(self, tmp_path):
+        from veles_tpu.config import root
+        from veles_tpu.serve.hive import load_model_package
+        from veles_tpu.serve.residency import ResidencyManager
+
+        pkg, members, w0 = _som_package(str(tmp_path))
+        pristine = copy.deepcopy(root.__dict__)
+        dev = JaxDevice(platform="cpu")
+        model = load_model_package(
+            "zoo_som", pkg, dev,
+            str(tmp_path / "install"), pristine)
+        assert model.sample_shape == (6, 6, 1)
+        mgr = ResidencyManager(dev, budget_bytes=1 << 30)
+        mgr.register(model)
+        engine = mgr.ensure("zoo_som")
+        engine.attach_batcher(mgr.max_batch, mgr.max_wait_s,
+                              label="zoo_som",
+                              sample_shape=model.sample_shape)
+
+        rng = np.random.default_rng(99)
+        x = rng.random((4, 6, 6, 1)).astype(np.float32)
+        served = np.asarray(engine.submit(x).result())
+
+        # host oracle: the member-loop mean of apply_fwd d2 maps
+        acc = None
+        for m in members:
+            p = {k: np.asarray(v)
+                 for k, v in m["params"][w0.forward.name].items()}
+            d2, _ = w0.forward.apply_fwd(p, x)
+            acc = d2 if acc is None else acc + d2
+        oracle = acc / len(members)
+        assert served.shape == (4, 25)
+        assert np.allclose(served, oracle, rtol=1e-5, atol=1e-6)
+        # the decisions a client actually reads off the map
+        assert np.array_equal(served.argmin(1), oracle.argmin(1))
+        qe = np.sqrt(np.maximum(served.min(1), 0.0))
+        assert np.allclose(
+            qe, np.sqrt(np.maximum(oracle.min(1), 0.0)),
+            rtol=1e-4, atol=1e-5)
+
+
+class TestSomHandoff:
+    """A just-trained SOM cohort adopts into serving HBM-to-HBM:
+    GAServingHandoff is generic over any engine with a member-stacked
+    ``_params`` tree, and SOMPopulationEngine is one."""
+
+    K = 2
+
+    def test_adopt_cohort_serves_trained_maps(self):
+        from veles_tpu.genetics.handoff import GAServingHandoff
+        from veles_tpu.serve.residency import ResidencyManager
+
+        w = build_som(fused=True)
+        engine = SOMPopulationEngine(w, HP)
+        init_members = [
+            {fn: {k: np.asarray(arr[i]) for k, arr in d.items()}
+             for fn, d in engine._params.items()}
+            for i in range(self.K)]
+        sample_shape = tuple(
+            np.asarray(w.loader.original_data.map_read()).shape[1:])
+        mgr = ResidencyManager(w.trainer.device,
+                               budget_bytes=1 << 30)
+        ho = GAServingHandoff(mgr, "som_winner", [w.forward],
+                              init_members,
+                              sample_shape=sample_shape)
+        fits = np.asarray(engine.run())
+        serve_engine = ho.adopt_cohort(engine, fits)
+        idx = ho.top_k(fits)
+        assert np.array_equal(
+            idx, np.argsort(fits, kind="stable")[:self.K]
+            .astype(np.int32))
+        for fn, d in serve_engine.stacked_params.items():
+            for k, arr in d.items():
+                want = np.asarray(engine._params[fn][k])[idx]
+                assert np.array_equal(np.asarray(arr)[:self.K], want)
+        x = np.asarray(w.loader.original_data.map_read()[:4],
+                       np.float32)
+        out = np.asarray(serve_engine.submit(x).result())
+        assert out.shape == (4, 25)
+        assert np.all(np.isfinite(out))
+        engine.release()
+        w.stop()

@@ -244,6 +244,135 @@ def build_mean_probs(forwards, n_members: int, compute_dtype,
     return mean_probs
 
 
+def build_som_step(coords):
+    """One masked Kohonen minibatch update — the body every SOM loop
+    (fused epoch scan, eager per-minibatch dispatch, cohort vmap)
+    shares, so fused-vs-eager parity is the same-jaxpr argument the
+    supervised builders make.  ``coords`` is the (N, 2) host grid;
+    the returned closure takes ``(weights, x, alpha, sigma, mask)``
+    with ``x`` still carrying the loader's sample shape."""
+    import jax.numpy as jnp
+
+    from veles_tpu.ops.kohonen import som_step_masked
+
+    coords = jnp.asarray(np.asarray(coords), jnp.float32)
+
+    def som_update(weights, x, alpha, sigma, mask):
+        x = x.reshape(x.shape[0], -1)
+        return som_step_masked(weights, x, coords, alpha, sigma, mask)
+
+    return som_update
+
+
+def build_som_epoch(coords, resident: bool = True, gather=None):
+    """A whole SOM superstep group (one epoch at full superstep) as
+    ONE donated ``lax.scan``: the prototype matrix is the scan carry
+    (donated by the caller's jit), the (alpha, sigma) schedule rides
+    the scan xs so the decay is applied PER STEP inside the trace, and
+    the per-step quantization-error / sample-count stats accumulate in
+    f32 in the carry (sequential adds — the same order the eager loop's
+    per-minibatch accumulator produces).
+
+    ``resident=True`` returns ``epoch(weights, alphas, sigmas,
+    dataset, indices, mask)`` gathering rows in-trace (``gather`` is
+    the row-sharded shard_map gather on a mesh, ``jnp.take``
+    otherwise); ``resident=False`` returns ``epoch(weights, alphas,
+    sigmas, xb, mask)`` consuming host-assembled (k, mb, ...) batches.
+    Argument order keeps weights at 0 (the donation slot) and the
+    member-varying arrays (weights, alphas, sigmas) leading, so the
+    cohort engine vmaps with in_axes=(0, 0, 0, None, ...)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    som_update = build_som_step(coords)
+
+    def _take(dataset, idx):
+        if gather is not None:
+            return gather(idx, dataset)
+        return jnp.take(dataset, idx, axis=0)
+
+    if resident:
+        def epoch(weights, alphas, sigmas, dataset, indices, mask):
+            def body(carry, xs):
+                w, qe, cnt = carry
+                idx, msk, a, s = xs
+                w, _, qe_b, n_b = som_update(w, _take(dataset, idx),
+                                             a, s, msk)
+                return (w, qe + qe_b, cnt + n_b), None
+
+            (weights, qe, cnt), _ = lax.scan(
+                body, (weights, jnp.float32(0.0), jnp.float32(0.0)),
+                (indices, mask, alphas, sigmas))
+            return weights, jnp.stack([qe, cnt])
+
+        return epoch
+
+    def epoch(weights, alphas, sigmas, xb, mask):
+        def body(carry, xs):
+            w, qe, cnt = carry
+            x, msk, a, s = xs
+            w, _, qe_b, n_b = som_update(w, x, a, s, msk)
+            return (w, qe + qe_b, cnt + n_b), None
+
+        (weights, qe, cnt), _ = lax.scan(
+            body, (weights, jnp.float32(0.0), jnp.float32(0.0)),
+            (xb, mask, alphas, sigmas))
+        return weights, jnp.stack([qe, cnt])
+
+    return epoch
+
+
+def build_som_eval(coords, resident: bool = True, gather=None):
+    """The evaluation-class twin of :func:`build_som_epoch`: same scan
+    skeleton, weights untouched (no donation), quantization error and
+    sample count accumulated.  ``resident=True`` returns
+    ``evaluate(weights, dataset, indices, mask)``; streaming returns
+    ``evaluate(weights, xb, mask)`` (``coords`` is accepted for
+    signature symmetry; evaluation needs distances only)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from veles_tpu.ops.kohonen import som_qe_masked
+
+    del coords
+
+    def _take(dataset, idx):
+        if gather is not None:
+            return gather(idx, dataset)
+        return jnp.take(dataset, idx, axis=0)
+
+    def _step(w, x, msk):
+        return som_qe_masked(w, x.reshape(x.shape[0], -1), msk)
+
+    if resident:
+        def evaluate(weights, dataset, indices, mask):
+            def body(carry, xs):
+                qe, cnt = carry
+                idx, msk = xs
+                qe_b, n_b = _step(weights, _take(dataset, idx), msk)
+                return (qe + qe_b, cnt + n_b), None
+
+            (qe, cnt), _ = lax.scan(
+                body, (jnp.float32(0.0), jnp.float32(0.0)),
+                (indices, mask))
+            return jnp.stack([qe, cnt])
+
+        return evaluate
+
+    def evaluate(weights, xb, mask):
+        def body(carry, xs):
+            qe, cnt = carry
+            x, msk = xs
+            qe_b, n_b = _step(weights, x, msk)
+            return (qe + qe_b, cnt + n_b), None
+
+        (qe, cnt), _ = lax.scan(
+            body, (jnp.float32(0.0), jnp.float32(0.0)), (xb, mask))
+        return jnp.stack([qe, cnt])
+
+    return evaluate
+
+
 # -- the core ----------------------------------------------------------
 
 

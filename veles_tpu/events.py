@@ -86,6 +86,8 @@ EV_GA_CHECKPOINT_UNRECOVERABLE = _ev("ga.checkpoint_unrecoverable")
 EV_GA_RESUMED = _ev("ga.resumed")
 EV_GA_HANDOFF = _ev("ga.handoff")
 
+EV_DBN_STAGE_HANDOFF = _ev("dbn.stage_handoff")
+
 EV_PREEMPT_REQUESTED = _ev("preempt.requested")
 EV_PREEMPT_DEADLINE_EXCEEDED = _ev("preempt.deadline_exceeded")
 EV_PREEMPT_FINAL_SNAPSHOT = _ev("preempt.final_snapshot")
@@ -201,6 +203,11 @@ CTR_ONLINE_STEPS_SKIPPED_BUSY = _ctr("online.steps_skipped_busy")
 CTR_ONLINE_PROMOTIONS = _ctr("online.promotions")
 CTR_ONLINE_ROLLBACKS = _ctr("online.rollbacks")
 
+CTR_SOM_FUSED_DISPATCHES = _ctr("som.fused_dispatches")
+CTR_SOM_FUSED_IMAGES = _ctr("som.fused_images")
+CTR_SOM_COHORTS = _ctr("som.cohorts")
+CTR_SOM_COHORT_MEMBERS = _ctr("som.cohort_members")
+
 CTR_EVALUATOR_JOBS = _ctr("evaluator.jobs")
 CTR_EVALUATOR_JOB_ERRORS = _ctr("evaluator.job_errors")
 
@@ -279,6 +286,7 @@ HIST_ONLINE_GATE_SECONDS = _hist("online.gate_seconds")
 # -- journaled spans (event + histogram of the same name) --------------
 
 SPAN_GA_COHORT_TRAIN = _span("ga.cohort_train")
+SPAN_SOM_COHORT_TRAIN = _span("som.cohort_train")
 SPAN_EVALUATOR_JOB_SECONDS = _span("evaluator.job_seconds")
 
 #: dynamic name families (built with f-strings at the call site; the

@@ -81,9 +81,11 @@ class GAServingHandoff(Logger):
     # -- the handoff ---------------------------------------------------
 
     def top_k(self, fitness: np.ndarray) -> np.ndarray:
-        """The member indices to slice: the K best (lowest — fitness
-        is min validation n_err) members, stable order so ties keep
-        the cohort's member order, exactly like the per-genome GA's
+        """The member indices to slice: the K best (lowest — min
+        validation n_err for supervised cohorts, min mean quantization
+        error for SOM cohorts; every engine's fitness is
+        lower-is-better) members, stable order so ties keep the
+        cohort's member order, exactly like the per-genome GA's
         sort."""
         order = np.argsort(np.asarray(fitness, np.float64),
                            kind="stable")
@@ -163,9 +165,13 @@ class GAServingHandoff(Logger):
     def adopt_cohort(self, cohort_engine: Any,
                      fitness: np.ndarray):
         """The whole move for a just-trained cohort: top-K by fitness,
-        gather, swap.  ``cohort_engine`` is a PopulationTrainEngine
-        whose :meth:`run` returned ``fitness``; its stacked params
-        must still be live (adopt BEFORE ``release()``)."""
+        gather, swap.  ``cohort_engine`` is any engine exposing the
+        member-stacked ``_params`` tree — ``PopulationTrainEngine``
+        (supervised nets AND CD-k RBM cohorts, whose step body the
+        shared Keel builders already trace) or
+        :class:`~veles_tpu.ops.kohonen.SOMPopulationEngine` — whose
+        :meth:`run` returned ``fitness``; its stacked params must
+        still be live (adopt BEFORE ``release()``)."""
         stacked = cohort_engine._params
         if stacked is None:
             raise RuntimeError(

@@ -403,6 +403,17 @@ TPU_LRN_RECOMPUTE = _knob(
     "VELES_TPU_LRN_RECOMPUTE", False, flag,
     "Recompute LRN normalizers in the backward pass instead of "
     "saving them (HBM for FLOPs).")
+SOM_FUSED = _knob(
+    "VELES_SOM_FUSED", True, flag,
+    "Train Kohonen SOM workflows as fused donated epoch scans on jax "
+    "devices (ONE dispatch per superstep group, schedule applied per "
+    "step inside the trace); `0` falls back to the eager "
+    "per-minibatch dispatch loop.")
+SOM_SUPERSTEP = _knob(
+    "VELES_SOM_SUPERSTEP", 0, int,
+    "Minibatches per fused SOM dispatch group (the SOM loader's "
+    "superstep); 0 groups the WHOLE class per firing — one dispatch "
+    "per epoch.")
 TPU_SYNTH_CACHE = _knob(
     "VELES_TPU_SYNTH_CACHE", False, flag,
     "Cache large synthetic datasets in-process across loader "

@@ -361,6 +361,69 @@ class FullBatchLoader(Loader):
         return data, labels, targets
 
 
+class DeviceArrayLoader(FullBatchLoader):
+    """FullBatchLoader over splits that are ALREADY device-resident
+    jax arrays — the DBN stage-chaining loader (Menagerie).
+
+    Stage k+1 of greedy DBN pretraining trains on the hidden
+    representations stage k computes; handing those through host numpy
+    costs a dataset-sized d2h fetch plus a dataset-sized h2d re-upload
+    per stage.  This loader accepts the device arrays verbatim:
+    ``load_data`` concatenates them ON DEVICE in the canonical
+    [test | valid | train] layout and binds ``original_data.devmem``
+    directly — ``original_data.mem`` stays ``None``, no host copy ever
+    materializes, and ``ingest_h2d_bytes`` (the ``Device.h2d_bytes``
+    delta across ``load_data``) pins the handoff at zero transfer.
+
+    ``targets_from_data=True`` aliases ``original_targets`` to the same
+    device buffer (autoencoder/RBM reconstruction targets).  The fused
+    path consumes the resident array as usual; the eager host wiring
+    still works (``map_read`` fetches on demand) but defeats the point.
+    """
+
+    def __init__(self, workflow=None,
+                 train: Any = None,
+                 valid: Any = None,
+                 test: Any = None,
+                 targets_from_data: bool = False,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, **kwargs)
+        self._splits = {TRAIN: train, VALID: valid, TEST: test}
+        self.targets_from_data = targets_from_data
+        #: ``Device.h2d_bytes`` consumed ingesting the dataset (the
+        #: ``load_data`` window) — the zero-copy-handoff pin reads
+        #: this.  The companion invariant is ``original_data.mem is
+        #: None`` after initialize: with no host copy in existence,
+        #: nothing can re-upload the dataset behind this counter.
+        self.ingest_h2d_bytes = 0
+
+    def load_data(self) -> None:
+        import jax.numpy as jnp
+        if self.device is None or not getattr(self.device, "is_jax",
+                                              False):
+            raise ValueError(
+                f"{self.name}: DeviceArrayLoader needs a jax device "
+                "(its splits are device arrays by contract)")
+        before = int(getattr(self.device, "h2d_bytes", 0) or 0)
+        xs = []
+        for klass in (TEST, VALID, TRAIN):
+            x = self._splits[klass]
+            if x is None:
+                self.class_lengths[klass] = 0
+                continue
+            self.class_lengths[klass] = int(x.shape[0])
+            xs.append(x)
+        if not xs:
+            raise ValueError(f"{self.name}: no splits given")
+        data = xs[0] if len(xs) == 1 else jnp.concatenate(xs, axis=0)
+        self.original_data.devmem = data
+        if self.targets_from_data:
+            self.original_targets.devmem = data
+        self._splits = {TRAIN: None, VALID: None, TEST: None}
+        self.ingest_h2d_bytes = \
+            int(getattr(self.device, "h2d_bytes", 0) or 0) - before
+
+
 class ArrayLoader(FullBatchLoader):
     """FullBatchLoader over in-memory numpy arrays per split.
 

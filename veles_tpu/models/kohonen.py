@@ -3,12 +3,20 @@
 Reference parity: veles/znicz/samples Kohonen demo — an unsupervised
 self-organizing map trained on feature vectors; Decision stops on max
 epochs; the tracked metric is the quantization error.
+
+On a jax device the workflow wires the Menagerie fused path by
+default: host minibatch fill is disabled, the loader groups a whole
+class per firing ($VELES_SOM_SUPERSTEP to override), and the trainer
+runs each group as ONE donated epoch scan through the Keel builders.
+``initialize(fused=False)`` (or $VELES_SOM_FUSED=0) keeps the eager
+per-minibatch dispatch loop — the parity oracle.
 """
 
 from __future__ import annotations
 
 from typing import Any
 
+from veles_tpu import knobs
 from veles_tpu.loader.synthetic import SyntheticClassificationLoader
 from veles_tpu.models import model_config
 from veles_tpu.mutable import Bool
@@ -46,6 +54,10 @@ class KohonenWorkflow(NNWorkflow):
         self.decision.loader = self.loader
         self.decision.evaluator = self.trainer  # publishes n_err/loss/count
 
+        # the serving/packaging contract (Forge members, Hive load,
+        # GA handoff) reads the forwards list like any other model
+        self.forwards = [self.forward]
+
         self.repeater.link_from(self.start_point)
         self.loader.link_from(self.repeater)
         self.trainer.link_from(self.loader)
@@ -54,6 +66,25 @@ class KohonenWorkflow(NNWorkflow):
         self.repeater.gate_block = self.decision.complete
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete
+
+    def initialize(self, device=None, **kwargs: Any) -> None:
+        """``fused=False`` forces the eager per-minibatch loop; the
+        default resolves $VELES_SOM_FUSED on jax devices (numpy stays
+        eager — there is nothing to fuse)."""
+        fused_kw = kwargs.pop("fused", None)
+        use_fused = device is not None \
+            and getattr(device, "is_jax", False) \
+            and (bool(fused_kw) if fused_kw is not None
+                 else bool(knobs.get(knobs.SOM_FUSED)))
+        if use_fused:
+            # one firing per class by default: the loader clamps the
+            # group to the minibatches remaining in the class, so a
+            # huge superstep means "the whole epoch in one dispatch"
+            self.loader.superstep = \
+                int(knobs.get(knobs.SOM_SUPERSTEP)) or (1 << 30)
+            self.loader.host_fill_enabled = False
+            self.trainer.fused = True
+        super().initialize(device=device, **kwargs)
 
 
 def create_workflow(launcher, **overrides):

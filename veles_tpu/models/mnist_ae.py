@@ -3,6 +3,12 @@
 Reference parity: veles/znicz/samples/MnistAE — encoder
 (ConvTanh + MaxPooling) and mirrored decoder (Depooling + Deconv),
 trained with MSE against the input image.
+
+Zoo long-tail status (Menagerie, docs/guide.md support matrix): a
+plain StandardWorkflow, so it already rides the fused superstep, the
+``PopulationTrainEngine`` cohort path, and Forge/Hive serving with no
+model-specific code — the autoencoder needed nothing the SOM and the
+CD-k RBM did.
 """
 
 from __future__ import annotations

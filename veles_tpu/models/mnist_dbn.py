@@ -24,9 +24,15 @@ Pipeline (``run()`` / the pieces individually):
 
 TPU notes: every stage is a StandardWorkflow, so pretraining and
 fine-tuning both run as fused jitted supersteps on a jax device and as
-the classic unit graph on numpy; the hidden representations for
-stage k+1 are computed host-side once per stage (a dataset-sized
-matmul, not a hot path).
+the classic unit graph on numpy.  On a jax device with the stage-1
+dataset HBM-resident, the greedy stages CHAIN ON DEVICE (Menagerie):
+stage k+1's hidden representations are computed by an
+``engine_core.donating_jit`` matmul over the resident data, sliced on
+device, and handed to the next stage through
+:class:`~veles_tpu.loader.fullbatch.DeviceArrayLoader` — zero host
+round-trip between stages (the ``stats`` out-param records the
+``Device.h2d_bytes`` delta over every handoff window; tests pin it at
+0).  The numpy/streaming fallback keeps the classic host-side handoff.
 """
 
 from __future__ import annotations
@@ -36,7 +42,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from veles_tpu.loader.base import TRAIN, VALID
-from veles_tpu.loader.fullbatch import ArrayLoader
+from veles_tpu.loader.fullbatch import ArrayLoader, DeviceArrayLoader
 from veles_tpu.loader.synthetic import MnistLoader
 from veles_tpu.models import model_config
 from veles_tpu.ops.standard_workflow import StandardWorkflow
@@ -46,7 +52,7 @@ DEFAULTS = {
                "n_valid": 10000},
     "hidden": [196, 64],
     "pretrain": {"epochs": 3, "learning_rate": 0.1,
-                 "gradient_moment": 0.5},
+                 "gradient_moment": 0.5, "cd_k": 1},
     "decision": {"max_epochs": 10, "fail_iterations": 50},
     "snapshotter": None,
 }
@@ -55,14 +61,28 @@ DEFAULTS = {
 def pretrain(device=None, loader_cfg: Optional[Dict[str, Any]] = None,
              hidden=(196, 64), epochs: int = 3,
              learning_rate: float = 0.1,
-             gradient_moment: float = 0.5) -> List[Dict[str, np.ndarray]]:
-    """Greedy layer-wise CD-1 pretraining.
+             gradient_moment: float = 0.5, cd_k: int = 1,
+             stats: Optional[Dict[str, Any]] = None,
+             ) -> List[Dict[str, np.ndarray]]:
+    """Greedy layer-wise CD-k pretraining.
 
     Returns one ``{"weights": (n_in, n_hid), "bias": (n_hid,)}`` per
     entry of ``hidden`` — ready for :func:`apply_pretrained`.
+
+    On a jax device with the stage-1 dataset HBM-resident (and not
+    row-sharded or under the uint8 ingest codec), the stages chain ON
+    DEVICE: hidden reps are an ``engine_core.donating_jit`` matmul
+    over the resident data, sliced on device, and handed to stage k+1
+    through ``DeviceArrayLoader`` — no host visit between stages.  A
+    ``stats`` dict out-param receives ``device_chain`` (bool),
+    ``interstage_h2d_bytes`` (``Device.h2d_bytes`` delta summed over
+    every handoff window; 0 on the device chain) and per-stage
+    ``stages`` records.
     """
     loader_cfg = dict(DEFAULTS["loader"], **(loader_cfg or {}))
     results: List[Dict[str, np.ndarray]] = []
+    rbm_cfg = {"learning_rate": learning_rate,
+               "gradient_moment": gradient_moment, "cd_k": int(cd_k)}
 
     # stage 1: binarized pixels -> RBM, on the real MNIST loader
     w1 = StandardWorkflow(
@@ -71,8 +91,7 @@ def pretrain(device=None, loader_cfg: Optional[Dict[str, Any]] = None,
         layers=[
             {"type": "binarization", "->": {}, "<-": {}},
             {"type": "rbm", "->": {"n_hidden": int(hidden[0])},
-             "<-": {"learning_rate": learning_rate,
-                    "gradient_moment": gradient_moment}},
+             "<-": dict(rbm_cfg)},
         ],
         loss_function="mse",
         decision_config={"max_epochs": epochs},
@@ -84,41 +103,100 @@ def pretrain(device=None, loader_cfg: Optional[Dict[str, Any]] = None,
         "weights": np.array(rbm_unit.weights.map_read()),
         "bias": np.array(rbm_unit.bias.map_read())})
 
-    # the representation the NEXT stage trains on: deterministic
-    # binarization (eval-mode threshold), then h = hidden_of(...)
     ld = w1.loader
-    data = np.asarray(ld.original_data.map_read(), np.float32)
-    x = (data > 0.5).astype(np.float32).reshape(len(data), -1)
     off_v, off_t = ld.class_offset(VALID), ld.class_offset(TRAIN)
     n_v, n_t = ld.class_lengths[VALID], ld.class_lengths[TRAIN]
+    chain_on_device = (
+        device is not None and getattr(device, "is_jax", False)
+        and ld.device_resident and not ld.shard_resident
+        and ld.dequant is None)
+    if stats is not None:
+        stats["device_chain"] = bool(chain_on_device and hidden[1:])
+        stats["interstage_h2d_bytes"] = 0
+        stats["stages"] = []
+
+    # the representation the NEXT stage trains on: deterministic
+    # binarization (eval-mode threshold), then h = hidden_of(...)
+    prev_dev = None
+    if chain_on_device:
+        from veles_tpu import events, telemetry
+        from veles_tpu.engine import core as engine_core
+        import jax.numpy as jnp
+
+        binarize = engine_core.donating_jit(
+            lambda d: (d > 0.5).astype(jnp.float32)
+            .reshape(d.shape[0], -1))
+        hidden_rep = engine_core.donating_jit(
+            lambda w, b, xx: rbm_unit.hidden_of(
+                {"weights": w, "bias": b}, xx))
+        x = binarize(ld.original_data.unmap())
+        prev_dev = (rbm_unit.weights.unmap(), rbm_unit.bias.unmap())
+    else:
+        data = np.asarray(ld.original_data.map_read(), np.float32)
+        x = (data > 0.5).astype(np.float32).reshape(len(data), -1)
     w1.stop()
 
     for depth, n_hid in enumerate(hidden[1:], start=2):
         # the representation stage k+1 trains on is literally what the
         # trained RBM computes — RBM.hidden_of, not a transcription
         prev = results[-1]
-        h = np.asarray(rbm_unit.hidden_of(
-            {"weights": prev["weights"], "bias": prev["bias"]}, x),
-            np.float32)
-        wk = StandardWorkflow(
-            loader_factory=lambda wf: ArrayLoader(
-                wf, name="loader",
-                train=(h[off_t:off_t + n_t],),
-                valid=(h[off_v:off_v + n_v],) if n_v else None,
-                targets_from_labels=True,
-                minibatch_size=loader_cfg["minibatch_size"]),
-            layers=[{"type": "rbm", "->": {"n_hidden": int(n_hid)},
-                     "<-": {"learning_rate": learning_rate,
-                            "gradient_moment": gradient_moment}}],
-            loss_function="mse",
-            decision_config={"max_epochs": epochs},
-            name=f"DbnPretrain{depth}")
-        wk.initialize(device=device)
+        if chain_on_device:
+            # the handoff window: hidden-rep matmul + device slicing +
+            # DeviceArrayLoader ingest — the dataset never leaves HBM,
+            # so the h2d delta over the whole window pins at zero
+            t0 = int(device.h2d_bytes)
+            h = hidden_rep(prev_dev[0], prev_dev[1], x)
+            ht = h[off_t:off_t + n_t]
+            hv = h[off_v:off_v + n_v] if n_v else None
+            compute_h2d = int(device.h2d_bytes) - t0
+            wk = StandardWorkflow(
+                loader_factory=lambda wf: DeviceArrayLoader(
+                    wf, name="loader", train=ht, valid=hv,
+                    targets_from_data=True,
+                    minibatch_size=loader_cfg["minibatch_size"]),
+                layers=[{"type": "rbm", "->": {"n_hidden": int(n_hid)},
+                         "<-": dict(rbm_cfg)}],
+                loss_function="mse",
+                decision_config={"max_epochs": epochs},
+                name=f"DbnPretrain{depth}")
+            wk.initialize(device=device)
+            handoff = compute_h2d + int(wk.loader.ingest_h2d_bytes)
+            telemetry.event(events.EV_DBN_STAGE_HANDOFF, stage=depth,
+                            rows=int(h.shape[0]),
+                            h2d_bytes=int(handoff))
+            if stats is not None:
+                stats["interstage_h2d_bytes"] += int(handoff)
+                stats["stages"].append(
+                    {"stage": depth, "rows": int(h.shape[0]),
+                     "h2d_bytes": int(handoff),
+                     # the companion invariant behind the =0 pin:
+                     # the stage dataset EXISTS only on device
+                     "host_free":
+                         wk.loader.original_data.mem is None})
+        else:
+            h = np.asarray(rbm_unit.hidden_of(
+                {"weights": prev["weights"], "bias": prev["bias"]}, x),
+                np.float32)
+            wk = StandardWorkflow(
+                loader_factory=lambda wf: ArrayLoader(
+                    wf, name="loader",
+                    train=(h[off_t:off_t + n_t],),
+                    valid=(h[off_v:off_v + n_v],) if n_v else None,
+                    targets_from_labels=True,
+                    minibatch_size=loader_cfg["minibatch_size"]),
+                layers=[{"type": "rbm", "->": {"n_hidden": int(n_hid)},
+                         "<-": dict(rbm_cfg)}],
+                loss_function="mse",
+                decision_config={"max_epochs": epochs},
+                name=f"DbnPretrain{depth}")
+            wk.initialize(device=device)
         wk.run()
         rbm = wk.forwards[0]
         results.append({
             "weights": np.array(rbm.weights.map_read()),
             "bias": np.array(rbm.bias.map_read())})
+        if chain_on_device:
+            prev_dev = (rbm.weights.unmap(), rbm.bias.unmap())
         wk.stop()
         x = h  # stage k+2 stacks on this stage's representation
 
@@ -184,6 +262,7 @@ def run(launcher):
         device=launcher.device, loader_cfg=cfg["loader"],
         hidden=cfg["hidden"], epochs=pre_cfg["epochs"],
         learning_rate=pre_cfg["learning_rate"],
-        gradient_moment=pre_cfg["gradient_moment"])
+        gradient_moment=pre_cfg["gradient_moment"],
+        cd_k=pre_cfg.get("cd_k", 1))
     apply_pretrained(launcher.workflow, pretrained)
     launcher.run()

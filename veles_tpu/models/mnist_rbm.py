@@ -3,8 +3,10 @@
 Reference parity: veles/znicz/samples MnistRBM (SURVEY.md §3.2 "RBM /
 other" row — reconstructed from the survey description, UNVERIFIED
 against the empty reference mount; SURVEY.md §0): binarized 28x28
-digits feed a 196-hidden-unit Bernoulli RBM trained by CD-1; progress
-is tracked as reconstruction MSE on the validation split.
+digits feed a 196-hidden-unit Bernoulli RBM trained by CD-k (k=1
+default, ``layers[1]["<-"]["cd_k"]`` to raise — the k Gibbs steps
+trace into the one fused dispatch, see ops/rbm.py); progress is
+tracked as reconstruction MSE on the validation split.
 """
 
 from __future__ import annotations
@@ -19,7 +21,8 @@ DEFAULTS = {
     "layers": [
         {"type": "binarization", "->": {}, "<-": {}},
         {"type": "rbm", "->": {"n_hidden": 196},
-         "<-": {"learning_rate": 0.1, "gradient_moment": 0.5}},
+         "<-": {"learning_rate": 0.1, "gradient_moment": 0.5,
+                "cd_k": 1}},
     ],
     "decision": {"max_epochs": 10, "fail_iterations": 50},
     "snapshotter": None,

@@ -125,8 +125,10 @@ def make_sharded_row_gather(mesh):
     """Traced ``gather(indices, *stores) -> rows per store`` over
     ROW-SHARDED resident stores (each device holds 1/N of the rows;
     ``parallel.mesh.put_row_sharded`` placement).  One store returns
-    its gathered rows bare; several (dataset + labels/targets) return
-    a tuple, gathered with ONE shard_map.
+    its gathered rows bare — the SOM epoch builders
+    (``engine_core.build_som_epoch`` / ``build_som_eval``) consume
+    that form directly, target-less as the SOM is; several (dataset +
+    labels/targets) return a tuple, gathered with ONE shard_map.
 
     The gather is a ``shard_map`` local gather + psum assembly: every
     device looks the full (replicated) index vector up in its OWN

@@ -1363,6 +1363,19 @@ class PopulationTrainEngine:
     off).  The dataset/targets are placed REPLICATED over the mesh
     (GA-scale datasets are small — sharding capacity is the
     row-sharded residency path's job, not this one's).
+
+    **Zoo long tail (Menagerie)**: the step body is composed from the
+    shared Keel builders (``build_forward`` / ``build_backward``), so
+    any unit the fused trace supports cohorts for free — including
+    CD-k RBM pretraining workflows (binarization + rbm layers): the
+    CD chain's Bernoulli keys thread through the same (seed,
+    rng_counter) contract the per-genome fused run uses, so a CD
+    cohort's member params are BITWISE-equal to per-genome runs
+    (pinned in tests/test_ga_cohort.py).  The SOM, which has no
+    gradient chain, gets its own engine —
+    :class:`veles_tpu.ops.kohonen.SOMPopulationEngine` — with the
+    same member-axis contract (``_params``, fitness vector, handoff
+    adoption).
     """
 
     def __init__(self, workflow, member_rates: np.ndarray,

@@ -7,10 +7,18 @@ gaussian neighborhood and exponentially decaying learning rate/radius.
 Unsupervised: no gradient-descent chain; the trainer IS the weight
 update.
 
-TPU path: one jitted step computes distances, winners, the
-batch-summed neighborhood update and the new prototype matrix, with
-the prototype buffer donated.  Numpy twin shares the same array-API
-code.
+TPU path (Menagerie): a whole superstep group — one EPOCH at the
+default grouping — is ONE donated ``lax.scan`` built through the Keel
+trace builders (``engine_core.build_som_epoch``): the prototype matrix
+is the donated scan carry, the (alpha, sigma) schedule rides the scan
+xs so the decay applies per step inside the trace, and rows gather
+in-trace from the resident dataset (or stream as host-assembled
+superstep batches).  The eager per-minibatch dispatch survives as the
+parity oracle (``VELES_SOM_FUSED=0``) and the numpy twin shares the
+same array-API code.  :class:`SOMPopulationEngine` stacks P prototype
+maps on a member axis and vmaps the same epoch body, so SOM
+hyperparameter cohorts train population-batched like the supervised
+cohorts of ops/fused.py.
 """
 
 from __future__ import annotations
@@ -20,8 +28,8 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from veles_tpu.accelerated_units import AcceleratedUnit
-from veles_tpu import prng
-from veles_tpu.loader.base import TRAIN
+from veles_tpu import events, prng, telemetry
+from veles_tpu.loader.base import TRAIN, VALID
 from veles_tpu.memory import Vector
 
 
@@ -56,8 +64,68 @@ def som_step(weights, x_flat, coords, alpha, sigma):
     return weights + delta, winners, qe_sum
 
 
+def som_step_masked(weights, x_flat, coords, alpha, sigma, mask):
+    """:func:`som_step` with a static-shape row-validity mask — the
+    fused scan body (ragged final minibatches ride the scan padded to
+    the fixed minibatch shape; the mask zeroes the padding rows out of
+    the neighborhood sums and the QE).
+
+    With an all-ones mask this is f32-BITWISE identical to
+    :func:`som_step`: every masked term multiplies by 1.0 (IEEE-exact)
+    and the update divides by ``mask.sum()``, which equals the batch
+    size.  Returns ``(new_weights, winners, qe_sum, n_valid)``.
+    """
+    if isinstance(weights, np.ndarray):
+        xp = np
+    else:
+        import jax.numpy as xp
+    d2 = ((x_flat * x_flat).sum(1, keepdims=True)
+          - 2.0 * x_flat @ weights.T
+          + (weights * weights).sum(1)[None, :])      # (B, N)
+    winners = d2.argmin(1)                             # (B,)
+    qe_sum = (xp.sqrt(xp.maximum(
+        d2[xp.arange(x_flat.shape[0]), winners], 0.0)) * mask).sum()
+    wc = coords[winners]                               # (B, 2)
+    gd2 = ((wc[:, None, :] - coords[None, :, :]) ** 2) \
+        .sum(-1).astype(weights.dtype)                 # (B, N)
+    h = xp.exp(-gd2 / (2.0 * sigma * sigma)) \
+        * mask[:, None]                                # (B, N)
+    num = h.T @ x_flat                                 # (N, D)
+    den = h.sum(0)[:, None]                            # (N, 1)
+    n = xp.maximum(mask.sum(), 1.0)
+    delta = alpha * (num - den * weights) / n
+    return weights + delta, winners, qe_sum, mask.sum()
+
+
+def som_qe_masked(weights, x_flat, mask):
+    """Masked quantization error of one minibatch (the evaluation-
+    class body): summed ``sqrt(min distance)`` over valid rows plus
+    the valid-row count."""
+    if isinstance(weights, np.ndarray):
+        xp = np
+    else:
+        import jax.numpy as xp
+    d2 = ((x_flat * x_flat).sum(1, keepdims=True)
+          - 2.0 * x_flat @ weights.T
+          + (weights * weights).sum(1)[None, :])
+    qe = (xp.sqrt(xp.maximum(d2.min(1), 0.0)) * mask).sum()
+    return qe, mask.sum()
+
+
 class KohonenForward(AcceleratedUnit):
-    """Distances + winners for the current minibatch (inference side)."""
+    """Distances + winners for the current minibatch (inference side).
+
+    Also the SOM's serving op: :meth:`apply_fwd` follows the fused
+    forward contract (``(params, x, rng, train) -> (y, residual)``),
+    returning the (B, N) squared-distance map — a Hive client reads
+    ``argmin`` for the winner and ``sqrt(max(min, 0))`` for the
+    per-sample quantization error, and the ensemble mean of member
+    maps is the cohort-consensus distance field.
+    """
+
+    #: no dropout/sampling anywhere in the SOM forward — the fused
+    #: builders skip the rng chain entirely
+    stochastic = False
 
     def __init__(self, workflow=None, shape: Tuple[int, int] = (8, 8),
                  **kwargs: Any) -> None:
@@ -92,6 +160,21 @@ class KohonenForward(AcceleratedUnit):
 
     def gather_params(self):
         return {"weights": self.weights.unmap()}
+
+    def param_vectors(self) -> Dict[str, Vector]:
+        """The stacking contract the cohort engines and Forge
+        packaging read (same shape as the supervised forwards')."""
+        return {"weights": self.weights}
+
+    def apply_fwd(self, params, x, rng=None, train=False):
+        """Fused/serving forward: (B, ...) samples -> (B, N) squared
+        distances to every prototype.  No residual (the SOM has no
+        gradient chain) and no rng (``stochastic`` is False)."""
+        x = x.reshape(x.shape[0], -1)
+        w = params["weights"]
+        d2 = ((x * x).sum(1, keepdims=True) - 2.0 * x @ w.T
+              + (w * w).sum(1)[None, :])
+        return d2, None
 
     def run(self) -> None:
         numpy_mode = self.device is None or not self.device.is_jax
@@ -129,12 +212,23 @@ class KohonenTrainer(AcceleratedUnit):
         self.sigma_min = sigma_min
         self.decay_epochs = decay_epochs
         self.loader = None
+        #: True = run whole superstep groups as ONE donated epoch scan
+        #: through the Keel builders (set by the workflow's fused
+        #: wiring; requires a jax device and the loader's host fill
+        #: disabled).  False keeps the eager per-minibatch dispatch.
+        self.fused = False
+        #: optional mesh for the row-sharded resident gather (the
+        #: loader's ``shard_resident`` placement)
+        self.mesh = None
         # metrics published for Decision (same contract as evaluators)
         self.n_err = Vector(name=f"{self.name}.n_err")
         self.loss = Vector(name=f"{self.name}.loss")
         self.count = Vector(name=f"{self.name}.count")
         self._coords_host = None
         self._coords_dev = None
+        self._core = None
+        self._train_epoch = None
+        self._eval_epoch = None
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
@@ -146,13 +240,39 @@ class KohonenTrainer(AcceleratedUnit):
             self._coords_dev = device.put(self._coords_host)
 
     def schedule(self) -> Tuple[float, float]:
-        t = min(getattr(self.loader, "epoch_number", 0),
-                self.decay_epochs) / max(self.decay_epochs, 1)
+        return self.schedule_at(getattr(self.loader, "epoch_number",
+                                        0))
+
+    def schedule_at(self, epoch: int) -> Tuple[float, float]:
+        t = min(epoch, self.decay_epochs) / max(self.decay_epochs, 1)
         alpha = self.alpha0 * (self.alpha_min / self.alpha0) ** t
         sigma = self.sigma0 * (self.sigma_min / self.sigma0) ** t
         return alpha, sigma
 
+    def schedule_steps(self, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """The (k,) per-step schedule of the current superstep group,
+        mirroring the eager sequence EXACTLY: the loader increments
+        ``epoch_number`` inside the same ``run()`` that emits the
+        epoch's final minibatch, so an eager loop trains steps
+        0..k-2 at the old epoch's (alpha, sigma) and only the last
+        step at the new one."""
+        ld = self.loader
+        e = getattr(ld, "epoch_number", 0)
+        ended = bool(ld.epoch_ended) if ld is not None else False
+        a_bulk, s_bulk = self.schedule_at(e - 1 if ended else e)
+        alphas = np.full((k,), a_bulk, np.float32)
+        sigmas = np.full((k,), s_bulk, np.float32)
+        if ended:
+            a_last, s_last = self.schedule_at(e)
+            alphas[k - 1] = a_last
+            sigmas[k - 1] = s_last
+        return alphas, sigmas
+
     def run(self) -> None:
+        if self.fused and self.device is not None \
+                and self.device.is_jax:
+            self._run_fused()
+            return
         if self.loader is not None and \
                 self.loader.minibatch_class != TRAIN:
             # evaluation classes: quantization error only
@@ -170,20 +290,41 @@ class KohonenTrainer(AcceleratedUnit):
             f.weights.map_invalidate()[:] = w
             self.loss.reset(np.float32([qe]))
         else:
+            # the eager jax dispatch runs the SAME masked body the
+            # fused scan traces (fused-vs-eager parity is the
+            # same-jaxpr argument) — a ragged final minibatch's
+            # np.resize padding rows are masked out of the update
+            # instead of training as duplicates
             if self._compiled is None:
                 from veles_tpu.engine import core as engine_core
                 self._compiled = engine_core.donating_jit(
-                    som_step, donate=(0,))
-            w, winners, qe = self._compiled(
+                    som_step_masked, donate=(0,))
+            mask = self._eager_mask()
+            w, winners, qe, _ = self._compiled(
                 f.weights.unmap(),
                 f.input.unmap().reshape(len(f.input), -1),
                 self._coords_dev,
-                np.float32(alpha), np.float32(sigma))
+                np.float32(alpha), np.float32(sigma), mask)
             f.weights.devmem = w
             self.loss.devmem = qe
+            # real rows only (ragged tails publish the masked count,
+            # matching the fused path's totals exactly)
+            self.n_err.reset(np.float32([0.0]))
+            self.count.reset(np.float32([mask.sum()]))
+            return
         n = len(f.input)
         self.n_err.reset(np.float32([0.0]))
         self.count.reset(np.float32([n]))
+
+    def _eager_mask(self) -> np.ndarray:
+        """The current minibatch's row-validity mask for the eager jax
+        dispatch (all ones when the loader exposes none)."""
+        ld = self.loader
+        if ld is not None and \
+                getattr(ld, "superstep_mask", None) is not None:
+            return np.ascontiguousarray(ld.superstep_mask[-1],
+                                        np.float32)
+        return np.ones(len(self.forward.input), np.float32)
 
     def _eval_only(self) -> None:
         f = self.forward
@@ -195,18 +336,314 @@ class KohonenTrainer(AcceleratedUnit):
             qe = np.sqrt(np.maximum(d2.min(1), 0)).sum()
             self.loss.reset(np.float32([qe]))
         else:
-            import jax.numpy as jnp
-
-            def eval_fn(wts, x):
-                out = f.apply({"weights": wts}, {"input": x})
-                return jnp.sqrt(jnp.maximum(out["output"].min(1), 0)).sum()
-
+            # compiled through the Keel seam like the train path (the
+            # engine-residency-seam contract: no stray device.compile
+            # dispatchers outside engine loops)
             if getattr(self, "_eval_compiled", None) is None:
-                self._eval_compiled = self.device.compile(eval_fn)
-            self.loss.devmem = self._eval_compiled(f.weights.unmap(),
-                                                   f.input.unmap())
+                from veles_tpu.engine import core as engine_core
+
+                def eval_fn(wts, x, mask):
+                    return som_qe_masked(
+                        wts, x.reshape(x.shape[0], -1), mask)[0]
+
+                self._eval_compiled = engine_core.donating_jit(eval_fn)
+            mask = self._eager_mask()
+            self.loss.devmem = self._eval_compiled(
+                f.weights.unmap(), f.input.unmap(), mask)
+            self.n_err.reset(np.float32([0.0]))
+            self.count.reset(np.float32([mask.sum()]))
+            return
         self.n_err.reset(np.float32([0.0]))
         self.count.reset(np.float32([len(f.input)]))
 
+    # -- the fused epoch path (Menagerie) ------------------------------
+
+    def _build_fused(self) -> None:
+        from veles_tpu.engine import core as engine_core
+
+        ld = self.loader
+        resident = bool(getattr(ld, "device_resident", True))
+        gather = None
+        if resident and getattr(ld, "shard_resident", False) \
+                and self.mesh is not None:
+            from veles_tpu.ops import batching
+            gather = batching.make_sharded_row_gather(self.mesh)
+        self._core = engine_core.ExecutionCore(
+            self.device, self.mesh, pool="train", name=self.name)
+        self._train_epoch = self._core.jit(
+            engine_core.build_som_epoch(self._coords_host,
+                                        resident=resident,
+                                        gather=gather),
+            donate=(0,))
+        self._eval_epoch = self._core.jit(
+            engine_core.build_som_eval(self._coords_host,
+                                       resident=resident,
+                                       gather=gather))
+        self._fused_resident = resident
+
+    def _run_fused(self) -> None:
+        ld = self.loader
+        f = self.forward
+        if self._core is None:
+            self._build_fused()
+        idxs = ld.superstep_indices
+        mask = np.ascontiguousarray(ld.superstep_mask, np.float32)
+        k = int(idxs.shape[0])
+        train = ld.minibatch_class == TRAIN
+        # the schedule rides the scan xs, applied PER STEP inside the
+        # trace — per-minibatch schedules are a host array away,
+        # never a retrace
+        alphas, sigmas = self.schedule_steps(k)
+        w = f.weights.unmap()
+        if self._fused_resident:
+            dataset = ld.original_data.unmap()
+            idx = np.ascontiguousarray(idxs, np.int32)
+            if train:
+                w, stats = self._train_epoch(w, alphas, sigmas,
+                                             dataset, idx, mask)
+            else:
+                stats = self._eval_epoch(w, dataset, idx, mask)
+        else:
+            xb = self._core.put(
+                np.ascontiguousarray(ld.superstep_data))
+            if train:
+                w, stats = self._train_epoch(w, alphas, sigmas, xb,
+                                             mask)
+            else:
+                stats = self._eval_epoch(w, xb, mask)
+        if train:
+            f.weights.devmem = w
+        self.loss.devmem = stats[0]
+        n = float(mask.sum())
+        self.n_err.reset(np.float32([0.0]))
+        self.count.reset(np.float32([n]))
+        telemetry.counter(events.CTR_SOM_FUSED_DISPATCHES).inc()
+        telemetry.counter(events.CTR_SOM_FUSED_IMAGES).inc(int(n))
+
     _unpicklable = AcceleratedUnit._unpicklable + (
-        "_coords_dev", "_eval_compiled")
+        "_coords_dev", "_eval_compiled", "_core", "_train_epoch",
+        "_eval_epoch")
+
+
+class SOMPopulationEngine:
+    """Population-batched SOM training: P prototype maps trained in
+    ONE vmapped fused epoch scan per loader firing — the
+    ``PopulationTrainEngine`` move applied to the zoo's unsupervised
+    long tail.
+
+    Each member is one (alpha0, alpha_min, sigma0, sigma_min)
+    hyperparameter genome over the SAME map shape and init (the
+    workflow's seeded prototype matrix), stacked on a leading member
+    axis; the per-member schedule rides the scan xs, so one donated
+    dispatch advances every member one superstep group.  The dataset
+    stays UNBATCHED (vmap broadcasts the gather), so HBM holds
+    prototypes x P, never data x P.
+
+    On a ``mesh`` the member axis shards P/N per device exactly like
+    the supervised cohort (padded to a whole per-device tile by
+    repeating member 0; padding rows are sliced off every fetch), with
+    per-member math bit-identical to the unsharded stacking — members
+    never reduce across each other.
+
+    :meth:`run` drives the workflow's OWN loader to
+    ``decision.max_epochs`` and returns the (P,) fitness vector: each
+    member's MINIMUM per-epoch mean quantization error, on the
+    validation class when the loader has one, else on train — the
+    quantity a per-member oracle run reads off its decision history.
+    The stacked maps stay live in ``_params`` (keyed by the forward's
+    name) for ``GAServingHandoff.adopt_cohort`` until
+    :meth:`release`.
+    """
+
+    def __init__(self, workflow, member_hparams: np.ndarray,
+                 mesh: Any = None) -> None:
+        trainer = workflow.trainer
+        device = trainer.device
+        if device is None or not getattr(device, "is_jax", False):
+            raise ValueError(
+                "SOMPopulationEngine needs a jax device (TPU or "
+                "XLA:CPU); per-member evaluation is the numpy path")
+        if trainer._coords_host is None:
+            raise ValueError("workflow must be initialized before "
+                             "building a SOM cohort")
+        self.workflow = workflow
+        self.trainer = trainer
+        self.forward = workflow.forward
+        self.loader = workflow.loader
+        self.decision = workflow.decision
+        self.device = device
+        hp = np.asarray(member_hparams, np.float32)
+        if hp.ndim != 2 or hp.shape[1] != 4:
+            raise ValueError(
+                "member hyperparameters must be a (P, 4) "
+                "[alpha0, alpha_min, sigma0, sigma_min] array; got "
+                f"{hp.shape}")
+        self.n_members = int(hp.shape[0])
+        self.streaming = not bool(getattr(self.loader,
+                                          "device_resident", True))
+        self.mesh = mesh if (mesh is not None
+                             and int(mesh.devices.size) > 1) else None
+        if self.mesh is not None:
+            from veles_tpu import knobs
+            from veles_tpu.parallel.mesh import shard_mode
+            if shard_mode(knobs.get(knobs.MESH_SHARD_MEMBERS)) \
+                    == "never":
+                self.mesh = None
+        self.member_sharded = self.mesh is not None
+        from veles_tpu.engine import core as engine_core
+        from veles_tpu.ops import batching
+        self._core = engine_core.ExecutionCore(
+            device, self.mesh, pool="cohort",
+            name=f"som_cohort:{workflow.name}")
+        if self.member_sharded:
+            n_dev = int(self.mesh.devices.size)
+            (hp,), self._n_stacked = batching.pad_members([hp], n_dev)
+        else:
+            self._n_stacked = self.n_members
+        self._hp = hp
+        w0 = np.asarray(self.forward.weights.map_read(), np.float32)
+        self._params = {self.forward.name: {
+            "weights": self._core.put_members(
+                np.stack([w0] * self._n_stacked))}}
+        epoch = engine_core.build_som_epoch(
+            trainer._coords_host, resident=not self.streaming)
+        evaluate = engine_core.build_som_eval(
+            trainer._coords_host, resident=not self.streaming)
+        if self.streaming:
+            train_axes = (0, 0, 0, None, None)
+            eval_axes = (0, None, None)
+        else:
+            train_axes = (0, 0, 0, None, None, None)
+            eval_axes = (0, None, None, None)
+        self._train_step = self._core.jit(
+            self._core.vmap_members(epoch, in_axes=train_axes),
+            donate=(0,))
+        self._eval_step = self._core.jit(
+            self._core.vmap_members(evaluate, in_axes=eval_axes))
+        self._core.charge(engine_core.tree_nbytes(self._params))
+
+    # -- per-member schedule -------------------------------------------
+
+    def _schedule_at(self, epoch: int) -> Tuple[np.ndarray,
+                                                np.ndarray]:
+        decay = self.trainer.decay_epochs
+        t = min(epoch, decay) / max(decay, 1)
+        a0, amin = self._hp[:, 0], self._hp[:, 1]
+        s0, smin = self._hp[:, 2], self._hp[:, 3]
+        return ((a0 * (amin / a0) ** t).astype(np.float32),
+                (s0 * (smin / s0) ** t).astype(np.float32))
+
+    def _member_schedule(self, k: int) -> Tuple[np.ndarray,
+                                                np.ndarray]:
+        """(P_stacked, k) alpha/sigma arrays for this firing — each
+        member's exponential decay evaluated with the SAME per-step
+        epoch logic :meth:`KohonenTrainer.schedule_steps` uses (the
+        loader increments ``epoch_number`` inside the run() that
+        emits the epoch's final minibatch, so only the group's LAST
+        step sees the new epoch)."""
+        ld = self.loader
+        e = getattr(ld, "epoch_number", 0)
+        ended = bool(ld.epoch_ended)
+        a_bulk, s_bulk = self._schedule_at(e - 1 if ended else e)
+        alphas = np.repeat(a_bulk[:, None], k, axis=1)
+        sigmas = np.repeat(s_bulk[:, None], k, axis=1)
+        if ended:
+            a_last, s_last = self._schedule_at(e)
+            alphas[:, k - 1] = a_last
+            sigmas[:, k - 1] = s_last
+        return np.ascontiguousarray(alphas), \
+            np.ascontiguousarray(sigmas)
+
+    def _fetch(self, stats) -> np.ndarray:
+        """One (P, 2) [qe_sum, count] fetch, REAL members only."""
+        stats = self._core.replicate_for_fetch(stats)
+        return np.asarray(stats)[:self.n_members].astype(np.float64)
+
+    # -- the run loop --------------------------------------------------
+
+    def run(self) -> np.ndarray:
+        with telemetry.span(events.SPAN_SOM_COHORT_TRAIN,
+                            journal=True, members=self.n_members):
+            telemetry.counter(events.CTR_SOM_COHORTS).inc()
+            telemetry.counter(
+                events.CTR_SOM_COHORT_MEMBERS).inc(self.n_members)
+            return self._run_inner()
+
+    def _run_inner(self) -> np.ndarray:
+        ld = self.loader
+        P = self.n_members
+        fwd_name = self.forward.name
+        max_epochs = self.decision.max_epochs
+        has_valid = ld.class_lengths[VALID] > 0
+        fit_class = VALID if has_valid else TRAIN
+        best = np.full(P, np.inf)
+        class_acc = np.zeros((P, 2), np.float64)
+        weights = self._params[fwd_name]["weights"]
+        while True:
+            ld.run()
+            idxs, mask = ld.superstep_indices, ld.superstep_mask
+            k = int(idxs.shape[0])
+            klass = ld.minibatch_class
+            if klass == fit_class or klass == TRAIN:
+                mask_dev = self._core.put_replicated(
+                    np.ascontiguousarray(mask, np.float32))
+            if klass == TRAIN:
+                alphas, sigmas = self._member_schedule(k)
+                al_dev = self._core.put_members(alphas)
+                si_dev = self._core.put_members(sigmas)
+                if self.streaming:
+                    xb = self._core.put_replicated(
+                        np.ascontiguousarray(ld.superstep_data))
+                    weights, stats = self._train_step(
+                        weights, al_dev, si_dev, xb, mask_dev)
+                else:
+                    weights, stats = self._train_step(
+                        weights, al_dev, si_dev,
+                        self._dataset(), self._core.put_replicated(
+                            np.ascontiguousarray(idxs, np.int32)),
+                        mask_dev)
+                self._params[fwd_name]["weights"] = weights
+                if fit_class == TRAIN:
+                    class_acc += self._fetch(stats)
+            elif klass == fit_class:
+                if self.streaming:
+                    xb = self._core.put_replicated(
+                        np.ascontiguousarray(ld.superstep_data))
+                    stats = self._eval_step(weights, xb, mask_dev)
+                else:
+                    stats = self._eval_step(
+                        weights, self._dataset(),
+                        self._core.put_replicated(
+                            np.ascontiguousarray(idxs, np.int32)),
+                        mask_dev)
+                class_acc += self._fetch(stats)
+            if not bool(ld.class_ended):
+                continue
+            if klass == fit_class:
+                mean_qe = class_acc[:, 0] / np.maximum(
+                    class_acc[:, 1], 1.0)
+                best = np.minimum(best, mean_qe)
+                class_acc = np.zeros((P, 2), np.float64)
+            if klass == TRAIN and max_epochs is not None and \
+                    ld.epoch_number >= max_epochs:
+                break
+        return best
+
+    def _dataset(self):
+        if self.member_sharded:
+            if getattr(self, "_dataset_dev", None) is None:
+                # the engine owns its mesh placement: replicate the
+                # host copy next to the member-sharded stacks
+                self._dataset_dev = self._core.put_replicated(
+                    self.loader.original_data.map_read())
+            return self._dataset_dev
+        return self.loader.original_data.unmap()
+
+    def release(self) -> None:
+        """Drop the stacked device state (serve-mode hygiene: a
+        process lives across many cohorts and HBM must not
+        accumulate)."""
+        self._params = None
+        self._train_step = self._eval_step = None
+        self._dataset_dev = None
+        self._core.release()

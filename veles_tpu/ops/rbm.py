@@ -1,5 +1,5 @@
-"""RBM pretraining family: Binarization, the RBM unit, and its CD-1
-trainer.
+"""RBM pretraining family: Binarization, the RBM unit, and its CD-k
+trainer (k Gibbs steps traced into the fused dispatch; k=1 default).
 
 Reference parity: veles/znicz/rbm_units.py (SURVEY.md §3.2 "RBM /
 other" row — reconstructed from the survey description, UNVERIFIED
@@ -194,28 +194,61 @@ class RBM(ForwardUnit):
 
 
 class GDRBM(GradientUnit):
-    """CD-1 trainer for :class:`RBM`.  err_output is ignored (CD is not
-    backprop); err_input is zeros — an RBM is pretrained as the first
-    layer of its workflow, nothing upstream consumes its error."""
+    """CD-k trainer for :class:`RBM`.  err_output is ignored (CD is
+    not backprop); err_input is zeros — an RBM is pretrained as the
+    first layer of its workflow, nothing upstream consumes its error.
+
+    ``cd_k`` (layer config ``"<-": {"cd_k": k}``) runs k Gibbs steps
+    per update, all TRACED into the one fused dispatch: the chain's
+    Bernoulli draws thread per (seed, step) keys — the residual's rng
+    key for the first sample (bitwise-identical to the historical
+    CD-1 at k=1) and ``fold_in(rng, t)`` for every later step t, so
+    two seeded runs of any k are bit-identical.  The numpy twin draws
+    the same chain sequentially from the ``rbm`` stream."""
+
+    def __init__(self, workflow=None, forward=None, cd_k: int = 1,
+                 **kwargs: Any) -> None:
+        super().__init__(workflow, forward=forward, **kwargs)
+        self.cd_k = int(cd_k)
+        if self.cd_k < 1:
+            raise ValueError(f"{self.name}: cd_k must be >= 1, got "
+                             f"{self.cd_k}")
 
     def backward_from_saved(self, params, saved, err_output):
         x, h0_prob, rng = saved
         v0 = _flat(x)
         n = v0.shape[0]
-        if isinstance(v0, np.ndarray):
+        k = self.cd_k
+        numpy_mode = isinstance(v0, np.ndarray)
+        if numpy_mode:
             from veles_tpu import prng as prng_mod
             gen = prng_mod.get("rbm").numpy
-            h0 = (gen.random(h0_prob.shape) < h0_prob) \
-                .astype(np.float32)
+
+            def sample(t, p):
+                return (gen.random(p.shape) < p).astype(np.float32)
         else:
             import jax
             if rng is None:
-                raise ValueError(f"{self.name}: traced CD-1 needs the "
-                                 "forward's rng key in the residual")
-            h0 = jax.random.bernoulli(rng, h0_prob).astype(v0.dtype)
+                raise ValueError(f"{self.name}: traced CD-{k} needs "
+                                 "the forward's rng key in the "
+                                 "residual")
+
+            def sample(t, p):
+                key = rng if t == 0 else jax.random.fold_in(rng, t)
+                return jax.random.bernoulli(key, p).astype(v0.dtype)
+
         f = self.forward
-        v1 = f.reconstruct(params, h0)
-        h1 = _sigmoid(v1 @ params["weights"] + params["bias"])
+        # k Gibbs steps, unrolled into the trace: h_t ~ Bern(p(h_t)),
+        # v_{t+1} = mean-field reconstruction, p(h_{t+1}) = sigmoid.
+        # Only the LAST step's (v, h_prob) feeds the negative phase
+        # (Hinton's CD-k estimator with a mean-field final half-step).
+        h = sample(0, h0_prob)
+        v1 = h1 = None
+        for t in range(k):
+            v1 = f.reconstruct(params, h)
+            h1 = _sigmoid(v1 @ params["weights"] + params["bias"])
+            if t + 1 < k:
+                h = sample(t + 1, h1)
         # update_params does w -= lr*g: negate so SGD ASCENDS the
         # CD objective (positive phase - negative phase)
         grads = {
@@ -223,7 +256,7 @@ class GDRBM(GradientUnit):
             "bias": -(h0_prob - h1).sum(axis=0) / n,
             "vbias": -(v0 - v1).sum(axis=0) / n,
         }
-        if isinstance(v0, np.ndarray):
+        if numpy_mode:
             err_in = np.zeros(x.shape, np.float32)
         else:
             import jax.numpy as jnp
