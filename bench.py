@@ -3092,6 +3092,56 @@ def mesh_metric_record(phase):
     }
 
 
+def gauntlet_metric(phase):
+    """Gauntlet production day (ISSUE 20 acceptance): one accountable
+    open-loop day — diurnal+burst traffic, the autoscaler tracking the
+    load curve, Evergreen armed, chaos (gray blip + a coordinated
+    SIGTERM mid-burst) — run by ``scripts/gauntlet.py`` in its own
+    XLA:CPU subprocess; its verdict record is adopted under
+    ``gauntlet_*`` keys.  The bars live in the script: zero
+    lost/corrupt answers, >=2 scale-ups and >=2 scale-downs, p99 held
+    in the non-degraded windows, a bitwise-deterministic trace, and
+    every fleet mutation explained by the merged journals."""
+    if os.environ.get("BENCH_SKIP_GAUNTLET"):
+        return None
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    phase("gauntlet: the production day (scripts/gauntlet.py)")
+    try:
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(here, "scripts", "gauntlet.py"), "--json"],
+            env=env, capture_output=True, text=True, timeout=1800,
+            cwd=here)
+        if not res.stdout.strip():
+            print(f"gauntlet phase produced no record "
+                  f"(rc={res.returncode}): {res.stderr[-2000:]}",
+                  file=sys.stderr)
+            return None
+        rec = json.loads(res.stdout.strip().splitlines()[-1])
+        acct = rec.get("accountability") or {}
+        out = {("gauntlet_" + k if not k.startswith("gauntlet")
+                else k): v
+               for k, v in rec.items()
+               if k not in ("accountability", "preemptions")}
+        out["gauntlet_preemptions"] = len(rec.get("preemptions", []))
+        out["gauntlet_events_explained"] = acct.get("explained")
+        out["gauntlet_events_unexplained"] = len(
+            acct.get("unexplained", []))
+        out["gauntlet_accounted"] = acct.get("accounted")
+        phase(f"gauntlet: ok={rec.get('gauntlet_ok')} "
+              f"answered={rec.get('answered')} "
+              f"lost={rec.get('lost')} ups={rec.get('scale_ups')} "
+              f"downs={rec.get('scale_downs')} "
+              f"accounted={acct.get('accounted')}")
+        return out
+    except Exception as e:  # noqa: BLE001 — enrichment only
+        print(f"gauntlet phase failed: {e}", file=sys.stderr)
+        return None
+
+
 def mesh_metric(phase):
     """Full-run wrapper: the mesh phase needs a CPU backend with
     MESH_DEVICES virtual devices, which the headline process (real
@@ -3198,6 +3248,18 @@ def main() -> None:
             print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}",
                   file=sys.stderr, flush=True)
         print(json.dumps(fleet_metric(_phase)), flush=True)
+        return
+    if "--gauntlet-only" in sys.argv:
+        # fast path: ONLY the Gauntlet production day (an elastic
+        # XLA:CPU fleet driven by scripts/gauntlet.py) — the ISSUE 20
+        # acceptance gate (open-loop day, scale up AND down, chaos,
+        # zero lost answers, 100% accountable) without the headline
+        t0 = time.perf_counter()
+
+        def _phase(msg):
+            print(f"[bench +{time.perf_counter() - t0:6.1f}s] {msg}",
+                  file=sys.stderr, flush=True)
+        print(json.dumps(gauntlet_metric(_phase)), flush=True)
         return
     if "--mesh-only" in sys.argv:
         # fast path: ONLY the Lattice mesh phase — forced
@@ -3565,6 +3627,13 @@ def main() -> None:
     ol = online_metric(phase)
     if ol:
         record.update(ol)
+    emit()
+
+    phase("running the Gauntlet production day (elastic XLA:CPU "
+          "fleet, scripts/gauntlet.py subprocess)")
+    ga_day = gauntlet_metric(phase)
+    if ga_day:
+        record.update(ga_day)
     emit()
 
     phase("measuring tracing overhead + assembly (Flightline, "

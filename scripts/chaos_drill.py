@@ -1062,6 +1062,77 @@ def drill_online__swap_mid_request():
         client.close()
 
 
+def drill_fleet__replica_flap():
+    """The Gauntlet's pathological member: replica 0 SIGKILLs itself
+    shortly after EVERY hello (``times=*`` — the respawn inherits the
+    arming and flaps again, forever).  The respawn backoff and the
+    scale controller's cooldown must COMPOSE: the monitor's
+    exponential backoff bounds the spawn rate (backoffs grow, never a
+    spawn hot-loop), the healthy peer answers every request with zero
+    loss, and the autoscaler — watching the least-loaded HEALTHY
+    pressure — takes no scale action at all (a flapping member is a
+    health problem, not a capacity signal)."""
+    from veles_tpu.serve.autoscale import (FleetAutoscaler,
+                                           ScaleController)
+    d = tempfile.mkdtemp(prefix="chaos_flap_")
+    mdir = os.path.join(d, "metrics")
+    router, oracle = _gray_fleet(
+        "fleet.replica_flap@times=*&after=0.6", d,
+        respawn_backoff=0.4, heartbeat_every=0.2,
+        heartbeat_deadline=2.0)
+    scaler = FleetAutoscaler(
+        router,
+        controller=ScaleController(
+            min_replicas=2, max_replicas=3, up_ms=400.0,
+            down_ms=10.0, up_sustain_s=1.0, down_sustain_s=2.0,
+            cooldown_s=3.0),
+        interval_s=0.2)
+    try:
+        x = np.ones((1, 6, 6, 1), np.float32)
+        want = oracle(x)
+        scaler.start()
+        window = 12.0
+        stop_at = time.monotonic() + window
+        answered = 0
+        while time.monotonic() < stop_at:
+            r = router.request("m", x, timeout=30)
+            assert "probs" in r, r
+            assert np.abs(np.asarray(r["probs"], np.float32)
+                          - want).max() < 1e-4
+            answered += 1
+            time.sleep(0.05)
+        deaths = [e for e in journal_events_from_dir(
+            mdir, events.EV_FLEET_REPLICA_DIED)
+            if e.get("replica") == 0]
+        assert len(deaths) >= 2, \
+            f"replica 0 flapped only {len(deaths)}x in {window}s"
+        # the backoff GROWS with consecutive deaths — no spawn storm:
+        # each flap costs >= after + the current backoff, so the
+        # window bounds the death count from above too
+        backoffs = [e.get("backoff", 0.0) for e in deaths]
+        assert backoffs == sorted(backoffs), backoffs
+        assert backoffs[-1] > backoffs[0], backoffs
+        assert len(deaths) <= int(window / 0.6) + 1, \
+            f"{len(deaths)} deaths in {window}s is a spawn hot-loop"
+        # the cooldown composes: a flapping member never reads as a
+        # capacity signal, so the fleet's shape is untouched
+        assert not journal_events_from_dir(
+            mdir, events.EV_FLEET_SCALE_UP)
+        assert not journal_events_from_dir(
+            mdir, events.EV_FLEET_SCALE_DOWN)
+        assert len(router.replicas) == 2
+        assert answered > 0
+        return {"answered": answered, "lost": 0,
+                "flap_deaths": len(deaths),
+                "backoff_first_s": round(backoffs[0], 2),
+                "backoff_last_s": round(backoffs[-1], 2),
+                "scale_actions": 0,
+                "journal_event": events.EV_FLEET_REPLICA_DIED}
+    finally:
+        scaler.close()
+        router.close(kill=True)
+
+
 DRILLS = [
     drill_snapshot__torn_write,
     drill_checkpoint__corrupt,
@@ -1077,6 +1148,7 @@ DRILLS = [
     drill_hive__garbage_response,
     drill_online__poison_batch,
     drill_online__swap_mid_request,
+    drill_fleet__replica_flap,
 ]
 
 

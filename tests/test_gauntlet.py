@@ -1,0 +1,497 @@
+"""Gauntlet unit tests: traffic determinism, the scale controller's
+hysteresis/cooldown/bounds, and the degradation ladder's strict
+ordering — all pure (no subprocesses, scripted clocks) — plus ONE
+real-fleet pin: scale-down under live load drains the victim, re-homes
+its exclusively-placed tail model BEFORE the SIGTERM, and loses zero
+requests (never a 404)."""
+
+import filecmp
+import os
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from veles_tpu.serve.autoscale import (ACT_DOWN, ACT_RELAX,
+                                       ACT_SATURATED, ACT_UP, RUNGS,
+                                       DegradationLadder,
+                                       ScaleController)
+from veles_tpu.serve.traffic import (Arrival, OpenLoopDriver,
+                                     TrafficSpec, generate,
+                                     read_trace, write_trace)
+
+
+def _spec(**kw):
+    base = dict(seed=7, duration_s=30.0, peak_rps=40.0, swing=10.0,
+                burst_every_s=8.0, burst_len_s=2.0, burst_mult=2.0,
+                models=["hot", "warm", "tail"], zipf_s=1.1)
+    base.update(kw)
+    return TrafficSpec(**base)
+
+
+class TestTrafficGenerator:
+    def test_deterministic_bitwise_trace(self, tmp_path):
+        """The acceptance pin: two generations of the same seeded
+        spec write BYTE-identical trace files."""
+        p1, p2 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        write_trace(p1, _spec(), generate(_spec()))
+        write_trace(p2, _spec(), generate(_spec()))
+        assert os.path.getsize(p1) > 0
+        assert filecmp.cmp(p1, p2, shallow=False), \
+            "same spec+seed must replay bit-identically"
+
+    def test_seed_changes_schedule(self):
+        a = generate(_spec(seed=1))
+        b = generate(_spec(seed=2))
+        assert [x.t for x in a] != [x.t for x in b]
+
+    def test_trace_roundtrip(self, tmp_path):
+        spec, arrivals = _spec(), generate(_spec())
+        path = str(tmp_path / "day.jsonl")
+        write_trace(path, spec, arrivals)
+        spec2, back = read_trace(path)
+        assert spec2.to_dict() == spec.to_dict()
+        assert len(back) == len(arrivals)
+        assert all(a.t == b.t and a.model == b.model
+                   and a.row_seed == b.row_seed
+                   for a, b in zip(arrivals, back))
+
+    def test_torn_trace_fails_loudly(self, tmp_path):
+        path = str(tmp_path / "torn.jsonl")
+        write_trace(path, _spec(), generate(_spec()))
+        lines = open(path).readlines()
+        open(path, "w").writelines(lines[:len(lines) // 2])
+        with pytest.raises(ValueError, match="torn"):
+            read_trace(path)
+
+    def test_diurnal_swing(self):
+        """Arrivals concentrate at mid-day: the peak-half of the day
+        must carry several times the trough-half's traffic (the
+        schedule really sweeps a >=10x rate swing)."""
+        spec = _spec(duration_s=60.0, burst_mult=1.0,
+                     peak_rps=50.0, swing=10.0)
+        arrivals = generate(spec)
+        # quarters 2+3 straddle the raised-cosine peak at t=30
+        mid = sum(1 for a in arrivals if 15.0 <= a.t < 45.0)
+        edge = len(arrivals) - mid
+        assert mid > 2.5 * max(1, edge)
+        # sanity: total volume is in the right ballpark (mean rate
+        # integrates to ~0.55 * peak over one full period)
+        assert 0.25 * 50 * 60 < len(arrivals) < 0.9 * 50 * 60
+
+    def test_zipf_skew(self):
+        spec = _spec(duration_s=60.0, peak_rps=60.0, zipf_s=1.5)
+        counts = {m: 0 for m in spec.models}
+        for a in generate(spec):
+            counts[a.model] += 1
+        assert counts["hot"] > counts["warm"] > counts["tail"] > 0
+
+    def test_burst_windows_raise_rate(self):
+        spec = _spec(duration_s=40.0, peak_rps=40.0, swing=1.0,
+                     burst_every_s=6.0, burst_len_s=3.0,
+                     burst_mult=3.0)
+        arrivals = generate(spec)
+        burst = [a for a in arrivals if a.burst]
+        plain = [a for a in arrivals if not a.burst]
+        assert burst and plain
+        # the window layout is reproducible: generate() draws it
+        # FIRST from the seeded rng, before any thinning draws
+        from veles_tpu.serve.traffic import _burst_windows
+        wins = _burst_windows(spec, np.random.default_rng(spec.seed))
+        span = sum(b - a for a, b in wins)
+        assert 0 < span < spec.duration_s
+        # swing=1 flattens the diurnal curve, so rate density inside
+        # burst windows must be ~burst_mult x the outside density
+        dens_b = len(burst) / span
+        dens_p = len(plain) / (spec.duration_s - span)
+        assert dens_b > 1.5 * dens_p
+
+
+class TestOpenLoopDriver:
+    def _arrivals(self, n=50, gap=0.002):
+        return [Arrival(i, i * gap, "m", 123 + i, False)
+                for i in range(n)]
+
+    def test_every_arrival_gets_one_outcome(self):
+        drv = OpenLoopDriver(lambda a: {"probs": [0.5]}, workers=8)
+        res = drv.run(self._arrivals())
+        assert [r["i"] for r in res] == list(range(50))
+        assert all(r["status"] == "ok" for r in res)
+
+    def test_outcome_classification(self):
+        def fn(a):
+            if a.i % 3 == 0:
+                return {"error": "overloaded", "overloaded": True}
+            if a.i % 3 == 1:
+                raise RuntimeError("boom")
+            return {"probs": [0.1], "pred": [0]}
+        res = OpenLoopDriver(fn, workers=4).run(self._arrivals(30))
+        by = {r["i"]: r["status"] for r in res}
+        assert by[0] == "shed" and by[1] == "error" and by[2] == "ok"
+
+    def test_latency_counts_from_scheduled_time(self):
+        """Open-loop honesty: a slow answer's latency includes the
+        schedule-relative delay, never less than the server time."""
+        import time as _t
+
+        def slow(a):
+            _t.sleep(0.05)
+            return {"probs": [1.0]}
+        res = OpenLoopDriver(slow, workers=4).run(
+            self._arrivals(n=4, gap=0.001))
+        assert all(r["latency_s"] >= 0.05 for r in res)
+
+
+class TestScaleController:
+    def _ctl(self, **kw):
+        base = dict(min_replicas=1, max_replicas=4, up_ms=200.0,
+                    down_ms=25.0, up_sustain_s=1.0,
+                    down_sustain_s=2.0, cooldown_s=5.0)
+        base.update(kw)
+        return ScaleController(**base)
+
+    def test_sustained_pressure_scales_up(self):
+        c = self._ctl()
+        assert c.observe(500.0, 2, 0.0) is None     # window opens
+        assert c.observe(500.0, 2, 0.5) is None     # not sustained yet
+        assert c.observe(500.0, 2, 1.0) == ACT_UP   # sustained
+
+    def test_blip_does_not_scale(self):
+        """Hysteresis: pressure that dips back into the band resets
+        the sustain window — one burst never spawns."""
+        c = self._ctl()
+        assert c.observe(500.0, 2, 0.0) is None
+        assert c.observe(100.0, 2, 0.5) is None     # back in band
+        assert c.observe(500.0, 2, 0.9) is None     # window restarts
+        assert c.observe(500.0, 2, 1.8) is None
+        assert c.observe(500.0, 2, 1.95) == ACT_UP
+
+    def test_cooldown_spaces_actions(self):
+        c = self._ctl()
+        assert c.observe(500.0, 2, 1.0) is None
+        assert c.observe(500.0, 2, 2.0) == ACT_UP   # t=2: action
+        assert c.observe(500.0, 3, 3.5) is None     # sustained again
+        assert c.observe(500.0, 3, 6.9) is None     # but in cooldown
+        assert c.observe(500.0, 3, 7.1) == ACT_UP   # cooldown passed
+
+    def test_max_clamp_saturates(self):
+        c = self._ctl(max_replicas=2)
+        c.observe(500.0, 2, 0.0)
+        assert c.observe(500.0, 2, 1.0) == ACT_SATURATED
+
+    def test_sustained_idle_scales_down(self):
+        c = self._ctl()
+        assert c.observe(5.0, 3, 0.0) is None
+        assert c.observe(5.0, 3, 1.0) is None
+        assert c.observe(5.0, 3, 2.0) == ACT_DOWN
+
+    def test_min_clamp_relaxes(self):
+        c = self._ctl(min_replicas=2)
+        c.observe(5.0, 2, 0.0)
+        assert c.observe(5.0, 2, 2.0) == ACT_RELAX
+
+    def test_band_resets_both_windows(self):
+        c = self._ctl()
+        c.observe(5.0, 3, 0.0)           # idle window opens
+        c.observe(100.0, 3, 1.0)         # in band: resets
+        assert c.observe(5.0, 3, 2.5) is None  # idle restarts at 2.5
+        assert c.observe(5.0, 3, 4.6) == ACT_DOWN
+
+    def test_up_and_down_share_the_cooldown(self):
+        c = self._ctl()
+        c.observe(500.0, 2, 0.0)
+        assert c.observe(500.0, 2, 1.0) == ACT_UP
+        c.observe(5.0, 3, 1.5)
+        # idle sustained by t=3.5 but cooldown runs to t=6
+        assert c.observe(5.0, 3, 3.5) is None
+        assert c.observe(5.0, 3, 6.5) == ACT_DOWN
+
+    def test_validates_band(self):
+        with pytest.raises(ValueError):
+            self._ctl(down_ms=300.0)     # inverted band
+        with pytest.raises(ValueError):
+            self._ctl(min_replicas=0)
+        with pytest.raises(ValueError):
+            ScaleController(min_replicas=3, max_replicas=2)
+
+    def test_from_knobs(self):
+        env = {"VELES_FLEET_SCALE_MIN": "2",
+               "VELES_FLEET_SCALE_MAX": "8",
+               "VELES_FLEET_SCALE_UP_MS": "150",
+               "VELES_FLEET_SCALE_COOLDOWN": "9"}
+        c = ScaleController.from_knobs(environ=env)
+        assert (c.min_replicas, c.max_replicas) == (2, 8)
+        assert c.up_ms == 150.0 and c.cooldown_s == 9.0
+
+
+class TestDegradationLadder:
+    def test_strict_engage_release_order(self):
+        lad = DegradationLadder()
+        engaged = [lad.engage() for _ in range(3)]
+        assert engaged == list(RUNGS)
+        assert lad.engage() is None          # exhausted
+        released = [lad.release() for _ in range(3)]
+        assert released == list(reversed(RUNGS))
+        assert lad.release() is None         # fully recovered
+        assert lad.depth == 0
+
+    def test_partial_recovery_re_engages_in_order(self):
+        lad = DegradationLadder()
+        lad.engage()                          # learner
+        lad.engage()                          # hedge
+        assert lad.release() == "hedge"       # LIFO
+        assert lad.engage() == "hedge"        # pressure returns
+        assert lad.engage() == "shed_tail"
+        assert lad.depth == 3
+
+
+class TestControllerLadderComposition:
+    """The autoscaler's decision table, driven through a scripted
+    signal sequence — the full production-day state machine without a
+    single subprocess."""
+
+    def test_full_day_script(self):
+        c = ScaleController(min_replicas=1, max_replicas=2,
+                            up_ms=200.0, down_ms=25.0,
+                            up_sustain_s=1.0, down_sustain_s=1.0,
+                            cooldown_s=2.0)
+        lad = DegradationLadder()
+        n = 1
+        log = []
+        # (t, pressure) — morning ramp, saturated noon, evening fall
+        script = [(0.0, 500.0), (1.0, 500.0),        # -> up (n=2)
+                  (3.0, 500.0), (4.0, 500.0),        # -> saturated
+                  (6.0, 500.0), (7.0, 500.0),        # -> saturated
+                  (9.0, 10.0), (10.0, 10.0),         # -> down...
+                  (12.0, 10.0), (13.0, 10.0),
+                  (15.0, 10.0), (16.0, 10.0),
+                  (18.0, 10.0), (19.0, 10.0)]
+        for t, p in script:
+            act = c.observe(p, n, t)
+            if act == ACT_UP:
+                n += 1
+                log.append("up")
+            elif act == ACT_SATURATED:
+                r = lad.engage()
+                if r:
+                    log.append(f"engage:{r}")
+            elif act == ACT_DOWN:
+                if lad.depth:
+                    log.append(f"release:{lad.release()}")
+                else:
+                    n -= 1
+                    log.append("down")
+            elif act == ACT_RELAX:
+                if lad.depth:
+                    log.append(f"release:{lad.release()}")
+        assert log == ["up", "engage:learner", "engage:hedge",
+                       "release:hedge", "release:learner", "down"]
+        assert n == 1 and lad.depth == 0
+
+
+# -- the real-fleet pin (satellite: retire ordering) -------------------
+
+WF_TEXT = textwrap.dedent("""
+    from veles_tpu import prng
+    from veles_tpu.datasets import synthetic_classification
+    from veles_tpu.loader import ArrayLoader
+    from veles_tpu.ops.standard_workflow import StandardWorkflow
+
+    def create_workflow(launcher):
+        prng.seed_all(4242)
+        train, valid, _ = synthetic_classification(
+            64, 16, (6, 6, 1), n_classes=3, seed=5)
+        return StandardWorkflow(
+            loader_factory=lambda w: ArrayLoader(
+                w, train=train, valid=valid, minibatch_size=16,
+                name="loader"),
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 12},
+                 "<-": {"learning_rate": 0.1}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.1}},
+            ],
+            decision_config={"max_epochs": 2}, name="gauntlet_wf")
+""")
+
+
+@pytest.fixture(scope="module")
+def pkg(tmp_path_factory):
+    """One small ensemble package (the test_fleet recipe)."""
+    from veles_tpu import prng
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.ensemble.packaging import pack_ensemble
+    from veles_tpu.launcher import load_workflow_module
+
+    d = str(tmp_path_factory.mktemp("gauntlet_pkg"))
+    wf_path = os.path.join(d, "wf_gauntlet.py")
+    with open(wf_path, "w") as f:
+        f.write(WF_TEXT)
+    mod = load_workflow_module(wf_path)
+
+    class FL:
+        workflow = None
+
+    prng.seed_all(77)
+    w = mod.create_workflow(FL())
+    w.initialize(device=NumpyDevice())
+    base = {fw.name: {k: np.asarray(v) for k, v in
+                      fw.gather_params().items()}
+            for fw in w.forwards}
+    rng = np.random.default_rng(77)
+    members = []
+    for _ in range(2):
+        params = {fn: {pn: (a + 0.05 * rng.standard_normal(a.shape)
+                            .astype(np.float32))
+                       for pn, a in p.items()}
+                  for fn, p in base.items()}
+        members.append({"params": params, "valid_error": 0.0,
+                        "seed": 77,
+                        "forward_names": [fw.name
+                                          for fw in w.forwards],
+                        "values": None})
+    path = os.path.join(d, "gauntlet.vpkg")
+    pack_ensemble(path, "gauntlet", members, wf_path)
+    return path
+
+
+class TestScaleDownUnderLoad:
+    """The retire-ordering pin: ``retire_replica`` must (1) mark the
+    victim so routing stops picking it, (2) RE-HOME its exclusively
+    placed tail model onto a survivor, (3) drain its in-flight queue —
+    all BEFORE the SIGTERM — so a scale-down in the middle of live
+    traffic loses zero requests and never 404s a tail model.  The
+    freed install dir must land in the warm pool and be reused by the
+    next scale-up."""
+
+    @pytest.fixture(scope="class")
+    def router(self, pkg, tmp_path_factory):
+        from veles_tpu.serve.fleet import PlacementPolicy
+        from veles_tpu.serve.router import FleetRouter
+        mdir = str(tmp_path_factory.mktemp("gauntlet_metrics"))
+        # hot={"core"}: core replicates everywhere, the two tail
+        # models partition one-per-replica — so whichever replica
+        # retires holds one of them EXCLUSIVELY
+        r = FleetRouter(
+            {"core": pkg, "tail_a": pkg, "tail_b": pkg},
+            n_replicas=2, backend="cpu", max_batch=16, max_wait_ms=5,
+            placement=PlacementPolicy(budget_bytes=1 << 30,
+                                      hot={"core"}),
+            metrics_dir=mdir, cwd=REPO)
+        yield r
+        r.close(kill=True)
+
+    def test_placement_splits_the_tail(self, router):
+        assert sorted(router.placement["core"]) == [0, 1]
+        tails = {m: router.placement[m] for m in ("tail_a", "tail_b")}
+        assert all(len(p) == 1 for p in tails.values()), tails
+        assert {p[0] for p in tails.values()} == {0, 1}, tails
+
+    def test_retire_under_load_loses_nothing(self, router):
+        from veles_tpu import events, telemetry
+        x = np.ones((1, 6, 6, 1), np.float32)
+        models = ["core", "tail_a", "tail_b"]
+        # warm every replica directly (compile the one dispatch shape
+        # + LRU-load every model) so the loaded window is steady
+        for r in router.replicas:
+            for m in models:
+                assert "probs" in r.client.request(m, x, timeout=120)
+
+        errors = []
+        ok = [0]
+        stop = threading.Event()
+
+        def loop(i):
+            while not stop.is_set():
+                m = models[i % len(models)]
+                res = router.request(m, x, timeout=60)
+                if "probs" in res:
+                    ok[0] += 1
+                elif not res.get("overloaded"):
+                    errors.append((m, res))
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=loop, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(1.0)
+            # the youngest replica retires while traffic flows; its
+            # exclusive tail model must be re-homed BEFORE the SIGTERM
+            victim_idx = router.retire_replica(cause="test",
+                                               drain_timeout=60.0)
+            assert victim_idx == 1
+            time.sleep(1.5)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+
+        assert not errors, f"lost/404'd {len(errors)}: {errors[:3]}"
+        assert ok[0] > 0
+        # placement no longer references the corpse — every model
+        # (incl. the victim's exclusive tail) routes to the survivor
+        for m, placed in router.placement.items():
+            assert victim_idx not in placed, (m, placed)
+        for m in models:
+            assert "probs" in router.request(m, x, timeout=60)
+        live = [r for r in router.replicas if not r.retiring]
+        assert len(live) == 1 and live[0].idx == 0
+        retired = telemetry.recent_events(
+            events.EV_FLEET_REPLICA_RETIRED)
+        assert retired and retired[-1]["replica"] == 1
+        assert retired[-1]["drained"] is True
+        # the victim's install dir joined the warm pool
+        assert router._warm_dirs
+
+    def test_scale_up_reuses_the_warm_dir(self, router):
+        from veles_tpu import events, telemetry
+        warm = list(router._warm_dirs)
+        newbie = router.add_replica(cause="test")
+        # indices are never reused: the corpse stays 1, the new
+        # member mints 2 and inherits the retired install dir
+        assert newbie is not None and newbie.idx == 2
+        assert newbie.install_dir == warm[-1]
+        assert not router._warm_dirs
+        spawned = telemetry.recent_events(events.EV_FLEET_SCALE_UP)
+        assert spawned and spawned[-1]["replica"] == 2
+        assert spawned[-1]["warm_dir"] is True
+        x = np.ones((1, 6, 6, 1), np.float32)
+        for m in ("core", "tail_a", "tail_b"):
+            assert "probs" in router.request(m, x, timeout=120)
+
+
+# -- the production day itself (slow soak) -----------------------------
+
+@pytest.mark.slow
+def test_gauntlet_production_day_slow():
+    """The full accountable soak: a long diurnal day with bursts, the
+    gray fault armed, a coordinated mid-burst preemption, an elastic
+    fleet riding the curve — and the post-run books must balance
+    (zero lost/corrupt answers, every scale/degrade/eject event
+    traced to a recorded cause).  ~10 min wall."""
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               GAUNTLET_DURATION=os.environ.get(
+                   "GAUNTLET_DURATION", "600"),
+               GAUNTLET_PREEMPTIONS="2")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "gauntlet.py"),
+         "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=1800)
+    assert p.returncode == 0, p.stderr[-4000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["gauntlet_ok"] is True
+    assert rec["lost"] == 0 and rec["corrupt"] == 0
+    assert rec["scale_ups"] >= 2 and rec["scale_downs"] >= 2
+    assert rec["accountability"]["unexplained"] == []

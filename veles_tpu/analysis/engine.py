@@ -88,7 +88,7 @@ _DEFAULTS: Dict[str, Any] = {
         "veles_tpu/genetics/worker.py", "veles_tpu/genetics/pool.py",
         "veles_tpu/online/tap.py", "veles_tpu/online/buffer.py",
         "veles_tpu/online/trainer.py", "veles_tpu/online/promote.py",
-        "scripts/chaos_drill.py"],
+        "scripts/chaos_drill.py", "scripts/gauntlet.py"],
     # lock-discipline / blocking-under-lock / the lock-order graph
     # walk apply to the thread-spawning modules
     "lock_modules": [
@@ -99,7 +99,8 @@ _DEFAULTS: Dict[str, Any] = {
         "veles_tpu/serve/batcher.py", "veles_tpu/serve/hive.py",
         "veles_tpu/serve/client.py", "veles_tpu/serve/residency.py",
         "veles_tpu/serve/fleet.py", "veles_tpu/serve/router.py",
-        "veles_tpu/serve/sentinel.py", "veles_tpu/online/tap.py",
+        "veles_tpu/serve/sentinel.py", "veles_tpu/serve/traffic.py",
+        "veles_tpu/serve/autoscale.py", "veles_tpu/online/tap.py",
         "veles_tpu/online/buffer.py", "veles_tpu/online/trainer.py",
         "veles_tpu/online/promote.py"],
     # waiter-discipline applies to the serve tier + the GA pool
@@ -107,14 +108,16 @@ _DEFAULTS: Dict[str, Any] = {
         "veles_tpu/serve/batcher.py", "veles_tpu/serve/client.py",
         "veles_tpu/serve/fleet.py", "veles_tpu/serve/hive.py",
         "veles_tpu/serve/residency.py", "veles_tpu/serve/router.py",
-        "veles_tpu/serve/sentinel.py", "veles_tpu/genetics/pool.py",
+        "veles_tpu/serve/sentinel.py", "veles_tpu/serve/traffic.py",
+        "veles_tpu/serve/autoscale.py", "veles_tpu/genetics/pool.py",
         "veles_tpu/online/tap.py", "veles_tpu/online/buffer.py",
         "veles_tpu/online/trainer.py", "veles_tpu/online/promote.py"],
     # wire-protocol applies to the modules that build JSONL lines
     "wire_modules": [
         "veles_tpu/serve/router.py", "veles_tpu/serve/client.py",
         "veles_tpu/serve/hive.py", "veles_tpu/serve/batcher.py",
-        "veles_tpu/serve/sentinel.py", "veles_tpu/online/tap.py",
+        "veles_tpu/serve/sentinel.py", "veles_tpu/serve/traffic.py",
+        "veles_tpu/online/tap.py",
         "veles_tpu/online/trainer.py", "veles_tpu/online/promote.py"],
     # thread-lifecycle applies to every thread-spawning module
     "thread_modules": [
@@ -125,6 +128,7 @@ _DEFAULTS: Dict[str, Any] = {
         "veles_tpu/serve/batcher.py", "veles_tpu/serve/hive.py",
         "veles_tpu/serve/client.py", "veles_tpu/serve/fleet.py",
         "veles_tpu/serve/router.py", "veles_tpu/serve/sentinel.py",
+        "veles_tpu/serve/traffic.py", "veles_tpu/serve/autoscale.py",
         "veles_tpu/online/trainer.py", "bench.py"],
     # the residency/donation seam: the ONLY modules allowed to call
     # jax.device_put or pass donate_argnums — everything else goes

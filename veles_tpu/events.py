@@ -119,6 +119,14 @@ EV_FLEET_SHUTDOWN = _ev("fleet.shutdown")
 EV_FLEET_REPLICA_EJECTED = _ev("fleet.eject.replica")
 EV_FLEET_REPLICA_REINSTATED = _ev("fleet.eject.reinstated")
 EV_FLEET_PROBE_RESULT = _ev("fleet.probe.result")
+EV_FLEET_SCALE_UP = _ev("fleet.scale.up")
+EV_FLEET_SCALE_DOWN = _ev("fleet.scale.down")
+EV_FLEET_REPLICA_RETIRED = _ev("fleet.replica_retired")
+EV_FLEET_DEGRADE_ENGAGE = _ev("fleet.degrade.engage")
+EV_FLEET_DEGRADE_RELEASE = _ev("fleet.degrade.release")
+
+EV_TRAFFIC_TRACE = _ev("traffic.trace")
+EV_TRAFFIC_DONE = _ev("traffic.done")
 
 EV_ONLINE_ARMED = _ev("online.armed")
 EV_ONLINE_GATE = _ev("online.gate")
@@ -192,6 +200,11 @@ CTR_FLEET_REINSTATEMENTS = _ctr("fleet.eject.reinstated_total")
 CTR_FLEET_PROBES = _ctr("fleet.probe.sent")
 CTR_FLEET_PROBES_OK = _ctr("fleet.probe.ok")
 CTR_FLEET_PROBES_FAILED = _ctr("fleet.probe.fail")
+CTR_FLEET_SCALE_UPS = _ctr("fleet.scale.ups")
+CTR_FLEET_SCALE_DOWNS = _ctr("fleet.scale.downs")
+CTR_FLEET_RETIRED = _ctr("fleet.replicas_retired")
+CTR_TRAFFIC_SENT = _ctr("traffic.sent")
+CTR_TRAFFIC_LATE = _ctr("traffic.late")
 
 CTR_ONLINE_TAPPED_ROWS = _ctr("online.tapped_rows")
 CTR_ONLINE_LABELED_ROWS = _ctr("online.labeled_rows")
@@ -249,6 +262,10 @@ GAUGE_FLEET_EST_WAIT_MS = _gauge("fleet.est_wait_ms")
 GAUGE_FLEET_DISPATCH_EMA_MS = _gauge("fleet.dispatch_ema_ms")
 GAUGE_FLEET_HEDGE_THRESHOLD_MS = _gauge("fleet.hedge.threshold_ms")
 GAUGE_FLEET_REPLICAS_EJECTED = _gauge("fleet.eject.current")
+GAUGE_FLEET_REPLICAS_TOTAL = _gauge("fleet.replicas_total")
+GAUGE_FLEET_DEGRADE_RUNGS = _gauge("fleet.degrade.rungs")
+GAUGE_FLEET_SCALE_PRESSURE_MS = _gauge("fleet.scale.pressure_ms")
+GAUGE_TRAFFIC_RATE_RPS = _gauge("traffic.rate_rps")
 
 GAUGE_ONLINE_BUFFER_ROWS = _gauge("online.buffer_rows")
 GAUGE_ONLINE_BUFFER_BYTES = _gauge("online.buffer_bytes")

@@ -77,6 +77,14 @@ Registered points (the call sites document their context keys):
                             dispatches race it — every answer must
                             stay oracle-clean (old params or new,
                             never torn)
+``fleet.replica_flap``      a hive replica SIGKILLs itself seconds
+                            after sending hello — on EVERY respawn,
+                            when armed with ``times=*`` (``replica``;
+                            knob: ``after`` secs) — the flapping
+                            replica that must drive the respawn
+                            backoff up instead of hot-looping spawns,
+                            and must never trick the scale controller
+                            into a spawn storm
 ==========================  ==========================================
 
 Determinism: the registry carries no clock and no global RNG — an
@@ -113,6 +121,7 @@ POINTS = frozenset((
     "hive.garbage_response",
     "online.poison_batch",
     "online.swap_mid_request",
+    "fleet.replica_flap",
 ))
 
 _log = logging.getLogger("veles_tpu.faults")

@@ -225,6 +225,80 @@ FLEET_RESPAWN_BACKOFF = _knob(
     "Initial seconds the fleet monitor backs off before respawning a "
     "dead replica (doubles per consecutive death, capped at 30s).")
 
+# -- elastic fleet (Gauntlet) ------------------------------------------
+
+FLEET_SCALE_MIN = _knob(
+    "VELES_FLEET_SCALE_MIN", 1, int,
+    "Floor of the elastic fleet's replica count: the scale "
+    "controller never retires below this many replicas.")
+FLEET_SCALE_MAX = _knob(
+    "VELES_FLEET_SCALE_MAX", 4, int,
+    "Ceiling of the elastic fleet's replica count: once the fleet is "
+    "at the ceiling, sustained pressure engages the graceful-"
+    "degradation ladder instead of spawning.")
+FLEET_SCALE_UP_MS = _knob(
+    "VELES_FLEET_SCALE_UP_MS", 200.0, float,
+    "Scale-up pressure threshold: when the BEST candidate replica's "
+    "estimated completion (queue depth x observed dispatch cadence) "
+    "stays above this many milliseconds for "
+    "$VELES_FLEET_SCALE_UP_SUSTAIN seconds, the controller spawns a "
+    "replica into a warm install dir.")
+FLEET_SCALE_DOWN_MS = _knob(
+    "VELES_FLEET_SCALE_DOWN_MS", 25.0, float,
+    "Scale-down idle threshold: when fleet pressure stays below this "
+    "many milliseconds for $VELES_FLEET_SCALE_DOWN_SUSTAIN seconds, "
+    "the controller retires the youngest replica (drain its router "
+    "queue, re-place its exclusive tail models, then SIGTERM).")
+FLEET_SCALE_UP_SUSTAIN = _knob(
+    "VELES_FLEET_SCALE_UP_SUSTAIN", 1.0, float,
+    "Seconds the scale-up pressure must be SUSTAINED before the "
+    "controller acts (the hysteresis half that keeps one burst from "
+    "spawning a replica).")
+FLEET_SCALE_DOWN_SUSTAIN = _knob(
+    "VELES_FLEET_SCALE_DOWN_SUSTAIN", 3.0, float,
+    "Seconds the fleet must stay idle below the scale-down threshold "
+    "before the controller retires a replica (longer than the up "
+    "sustain on purpose: spawning is slow, flapping is worse).")
+FLEET_SCALE_COOLDOWN = _knob(
+    "VELES_FLEET_SCALE_COOLDOWN", 5.0, float,
+    "Seconds between ANY two scale/degradation actions — the "
+    "controller's refractory period, which also keeps a respawn-"
+    "backoff storm (fleet.replica_flap) from compounding into a "
+    "spawn hot-loop.")
+FLEET_SCALE_INTERVAL = _knob(
+    "VELES_FLEET_SCALE_INTERVAL", 0.25, float,
+    "Seconds between autoscaler signal polls (the controller "
+    "observes fleet pressure on this cadence).")
+
+# -- traffic replay (Gauntlet) -----------------------------------------
+
+TRAFFIC_SEED = _knob(
+    "VELES_TRAFFIC_SEED", 0, int,
+    "Seed of the open-loop traffic generator: the whole arrival "
+    "schedule (times, model mix, burst placement) is a pure function "
+    "of the spec + this seed, so a logged trace replays bit-"
+    "identically.")
+TRAFFIC_DURATION_S = _knob(
+    "VELES_TRAFFIC_DURATION_S", 60.0, float,
+    "Length of the generated production day in seconds.")
+TRAFFIC_PEAK_RPS = _knob(
+    "VELES_TRAFFIC_PEAK_RPS", 60.0, float,
+    "Arrival rate at the top of the diurnal sine (requests/second); "
+    "the trough is peak / $VELES_TRAFFIC_SWING.")
+TRAFFIC_SWING = _knob(
+    "VELES_TRAFFIC_SWING", 10.0, float,
+    "Peak-to-trough ratio of the diurnal arrival curve (>= 10x is "
+    "the production-day acceptance bar).")
+TRAFFIC_BURST_MULT = _knob(
+    "VELES_TRAFFIC_BURST_MULT", 2.0, float,
+    "Rate multiplier inside a Poisson-placed burst window (bursts "
+    "ride ON TOP of the diurnal curve).")
+TRAFFIC_ZIPF_S = _knob(
+    "VELES_TRAFFIC_ZIPF_S", 1.1, float,
+    "Zipf exponent of the multi-model popularity skew: model rank k "
+    "draws traffic proportional to 1/k^s — the long tail that makes "
+    "shed-tail-before-hot-prefix degradation mean something.")
+
 # -- online learning (Evergreen) ---------------------------------------
 
 ONLINE = _knob(

@@ -399,6 +399,47 @@ def fleet_rows(metrics_dir: str):
     return rows
 
 
+#: the journal events that mutate the fleet's shape — the rows of the
+#: elastic timeline (Gauntlet), each with the field naming its cause
+_SCALE_EVENTS = {
+    "fleet.scale.up": "scale-up",
+    "fleet.scale.down": "scale-down",
+    "fleet.replica_retired": "retired",
+    "fleet.degrade.engage": "degrade",
+    "fleet.degrade.release": "recover",
+}
+
+
+def scale_timeline(metrics_dir: str):
+    """The elastic-fleet timeline from the ROUTER journal: one row per
+    scale/degradation event — ``{t_s, kind, replica, rung, cause,
+    n_replicas}`` with ``t_s`` relative to the fleet's ready event —
+    how an operator reads a production day's replica-count curve (and
+    WHY each step happened) after the fact."""
+    _reg, _snaps, _journals, events = load_dir(metrics_dir)
+    t0 = None
+    for ev in events:
+        if ev.get("event") == "fleet.ready":
+            t0 = ev.get("ts")
+            break
+    rows = []
+    for ev in events:
+        kind = _SCALE_EVENTS.get(ev.get("event"))
+        if not kind:
+            continue
+        ts = ev.get("ts")
+        rows.append({
+            "t_s": round(ts - t0, 1)
+            if ts is not None and t0 is not None else None,
+            "kind": kind,
+            "replica": ev.get("replica"),
+            "rung": ev.get("rung"),
+            "cause": ev.get("cause"),
+            "n_replicas": ev.get("n_replicas"),
+        })
+    return rows
+
+
 def fleet_model_rows(reg: Registry, events):
     """The per-model traffic split (the canary A/B read) from the
     ROUTER process's registry: one row per ``fleet.model.<name>.*``
@@ -610,6 +651,18 @@ def render_fleet(metrics_dir: str) -> str:
                 f"{util:>6} " + " ".join(
                     f"{_fmt(round(pools.get(p, 0) / mib, 2)):>9}"
                     for p in ("serve", "train", "cohort", "scratch")))
+    tl = scale_timeline(metrics_dir)
+    if tl:
+        out.append("")
+        out.append("-- fleet scale timeline --")
+        out.append(f"  {'t+s':>8} {'event':<10} {'replica':>7} "
+                   f"{'n':>3} {'rung':<10} cause")
+        for r in tl:
+            out.append(
+                f"  {_fmt(r['t_s']):>8} {r['kind']:<10} "
+                f"{_fmt(r['replica']):>7} {_fmt(r['n_replicas']):>3} "
+                f"{(r['rung'] or '-'):<10} "
+                f"{r['cause'] or '-'}".rstrip())
     mrows = fleet_model_rows(reg, events)
     if mrows:
         out.append("")

@@ -267,6 +267,11 @@ class OnlineLearner(Logger):
         #: EMA of the fetched step wall (ms) — the SLO headroom input
         self._step_ema_ms: Optional[float] = None
         self._stop = threading.Event()
+        #: the elastic fleet's first degradation rung: a suspended
+        #: learner stays armed (taps keep filling the buffers) but
+        #: takes no steps — under sustained load there are no idle
+        #: gaps to scavenge, and the ladder wants the capacity back
+        self._suspended = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     # -- arming --------------------------------------------------------
@@ -330,6 +335,22 @@ class OnlineLearner(Logger):
         if self._thread is not None:
             self._thread.join(timeout=timeout)
 
+    def suspend(self) -> None:
+        """Park the scavenger (idempotent): tapping continues, steps
+        and gate rounds stop until :meth:`resume`."""
+        if not self._suspended.is_set():
+            self._suspended.set()
+            self.info("online: learner SUSPENDED (degradation rung)")
+
+    def resume(self) -> None:
+        if self._suspended.is_set():
+            self._suspended.clear()
+            self.info("online: learner resumed")
+
+    @property
+    def suspended(self) -> bool:
+        return self._suspended.is_set()
+
     # -- the scavenger loop -------------------------------------------
 
     def _serving_idle(self) -> bool:
@@ -362,6 +383,8 @@ class OnlineLearner(Logger):
     def _loop(self) -> None:
         poll = max(0.001, self.idle_s / 2.0 if self.idle_s else 0.001)
         while not self._stop.wait(poll):
+            if self._suspended.is_set():
+                continue
             with self._lock:
                 items = list(self._trainers.items())
             for name, trainer in items:

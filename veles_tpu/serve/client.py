@@ -282,6 +282,18 @@ class HiveClient:
         self._send({"op": "learn", "id": jid})
         return self._wait(jid, timeout)["learn"]
 
+    def learner_ctl(self, suspend: bool,
+                    timeout: float = 60.0) -> Dict[str, Any]:
+        """Suspend or resume the hive's online learner (the elastic
+        fleet's first degradation rung — under pressure there are no
+        idle gaps to scavenge).  Ack: ``{"suspended": bool,
+        "online": bool}``; ``online`` False means no learner is armed
+        and the op was a no-op."""
+        jid = self._draw_id()
+        self._send({"op": "learner_suspend" if suspend
+                    else "learner_resume", "id": jid})
+        return self._wait(jid, timeout)["learner_ctl"]
+
     def cancel(self, jid: int) -> bool:
         """Abandon interest in request ``jid`` — the timeout-cleanup /
         hedge-loser path.  Returns True when the response had already

@@ -43,6 +43,12 @@ from veles_tpu.analysis import witness
 from veles_tpu.logger import Logger
 from veles_tpu.serve.client import HiveClient
 
+#: a replica must SURVIVE this long past its hello for a death to
+#: reset the crash streak — a flapping member (clean hello, dead
+#: seconds later, forever) keeps escalating its respawn backoff
+#: toward the 30s cap instead of spawn-storming at the base rate
+STABLE_UPTIME_S = 10.0
+
 
 class PlacementPolicy:
     """Model -> preferred replica set, under a per-replica budget.
@@ -121,7 +127,8 @@ class Replica(Logger):
                  cwd: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None,
                  mesh: int = 0,
-                 start_timeout: float = 180.0) -> None:
+                 start_timeout: float = 180.0,
+                 install_dir: Optional[str] = None) -> None:
         self.idx = idx
         self.models = dict(models)
         self.backend = backend
@@ -149,17 +156,28 @@ class Replica(Logger):
         self.cwd = cwd
         self.env = env
         self.start_timeout = start_timeout
-        #: reused across respawns: the package unpack stays warm
-        self.install_dir = tempfile.mkdtemp(
+        #: reused across respawns: the package unpack stays warm.  An
+        #: elastic fleet passes a pooled dir from a RETIRED replica so
+        #: a scale-up inherits the unpack (and on a real chip the
+        #: persistent compile cache) instead of paying a cold install
+        self.install_dir = install_dir or tempfile.mkdtemp(
             prefix=f"fleet_replica{idx}_")
         self.client: Optional[HiveClient] = None
         self.healthy = False
+        #: set by the router's scale-down path BEFORE the drain: a
+        #: retiring replica takes no new work, and the monitor neither
+        #: respawns it nor counts its orderly exit as a death
+        self.retiring = False
         self.deaths = 0
         #: set by mark_dead on the healthy->dead transition; the
         #: monitor consumes it exactly once (death accounting +
         #: backoff scheduling), whoever noticed first
         self.death_kind: Optional[str] = None
         self._consecutive_deaths = 0
+        #: monotonic stamp of the last successful hello — a death
+        #: within STABLE_UPTIME_S of it continues the crash streak
+        #: (the backoff escalates), a longer stint resets it
+        self._ready_at: Optional[float] = None
         self.next_respawn_at = 0.0
         self._lock = witness.lock("fleet.replica")
         #: router-side in-flight requests (the bounded router queue)
@@ -200,7 +218,11 @@ class Replica(Logger):
                 if budget else None
             self.healthy = True
             self.death_kind = None
-            self._consecutive_deaths = 0
+            # NOT a streak reset: a flapping replica (crash shortly
+            # after a clean hello, respawn, crash again) must keep
+            # escalating its backoff — only surviving STABLE_UPTIME_S
+            # clears the streak (judged at death time in _on_death)
+            self._ready_at = time.monotonic()
             self.inflight = 0
             self._dispatch_base = (0, 0.0)
             self._rows_base = (0, 0.0)
@@ -360,6 +382,23 @@ class ReplicaSet(Logger):
     def healthy(self) -> List[Replica]:
         return [r for r in self.replicas if r.healthy]
 
+    # -- elastic membership (the autoscaler's two verbs) ---------------
+
+    def add(self, r: Replica) -> None:
+        """Adopt an ALREADY-SPAWNED replica into supervision.  The
+        caller spawns first (slow: jax import + install) so the
+        monitor never sees a half-started member."""
+        self.replicas.append(r)
+        self._update_health_gauge()
+
+    def remove(self, r: Replica) -> None:
+        """Drop a replica from supervision (scale-down: the router
+        drains and terminates it AFTER removal, so the monitor cannot
+        mistake the orderly SIGTERM exit for a death to respawn)."""
+        if r in self.replicas:
+            self.replicas.remove(r)
+        self._update_health_gauge()
+
     def _update_health_gauge(self) -> None:
         telemetry.gauge(events.GAUGE_FLEET_REPLICAS_HEALTHY).set(
             len(self.healthy()))
@@ -376,9 +415,15 @@ class ReplicaSet(Logger):
                 >= self.stats_every
             if poll_stats:
                 self._last_stats_poll = now
-            for r in self.replicas:
+            # snapshot: the autoscaler adds/removes members while the
+            # monitor iterates
+            for r in list(self.replicas):
                 if self._closing:
                     return
+                if r.retiring:
+                    # the router's scale-down path owns this replica's
+                    # remaining lifecycle (drain -> SIGTERM -> remove)
+                    continue
                 if r.healthy and not r.alive:
                     r.mark_dead("eof")
                     self._on_death(r)
@@ -408,6 +453,14 @@ class ReplicaSet(Logger):
         kind = r.death_kind or "eof"
         r.death_kind = None
         r.deaths += 1
+        # the streak is judged by UPTIME, not by hello success: a
+        # replica that flaps (clean hello, dead again within
+        # STABLE_UPTIME_S) keeps escalating its backoff toward the
+        # 30s cap; one that served a stable stint starts over
+        uptime = (time.monotonic() - r._ready_at) \
+            if r._ready_at is not None else None
+        if uptime is not None and uptime >= STABLE_UPTIME_S:
+            r._consecutive_deaths = 0
         r._consecutive_deaths += 1
         backoff = min(30.0, self.respawn_backoff
                       * (2 ** (r._consecutive_deaths - 1)))
@@ -452,6 +505,7 @@ class ReplicaSet(Logger):
         if self._monitor_thread is not None:
             self._monitor_thread.join(timeout=5.0)
         from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max(1, len(self.replicas))) as tp:
-            list(tp.map(lambda r: r.close(kill=kill), self.replicas))
+        replicas = list(self.replicas)
+        with ThreadPoolExecutor(max(1, len(replicas))) as tp:
+            list(tp.map(lambda r: r.close(kill=kill), replicas))
         self._update_health_gauge()

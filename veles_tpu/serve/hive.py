@@ -314,6 +314,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     emit(hello)
     telemetry.flush()
 
+    flap = faults.fire("fleet.replica_flap")
+    if flap:
+        # Faultline ``fleet.replica_flap``: this replica SIGKILLs
+        # itself ``after`` seconds past hello — armed with ``times=*``
+        # every respawn inherits the env var and flaps again, the
+        # pathological member the respawn backoff and the scale
+        # controller's cooldown must absorb without a spawn storm
+        import time as _time
+        flap_after = float(flap.get("after", 1.0))
+
+        def _flap() -> None:
+            _time.sleep(flap_after)
+            try:
+                trace.dump("flap")
+            except Exception:  # noqa: BLE001 — the kill is the point
+                pass
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        threading.Thread(target=_flap, daemon=True,
+                         name="fault-replica-flap").start()
+
     stop = {"signal": None}
     stop_event = threading.Event()
 
@@ -374,6 +395,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         if op == "learn":
             emit({"id": job.get("id"),
                   "learn": learner.status() if learner else {}})
+            return True
+        if op in ("learner_suspend", "learner_resume"):
+            # the degradation ladder's first rung, fleet-fanned by the
+            # router: a no-op ack when no learner is armed
+            if learner is not None:
+                if op == "learner_suspend":
+                    learner.suspend()
+                else:
+                    learner.resume()
+            emit({"id": job.get("id"), "learner_ctl": {
+                "online": learner is not None,
+                "suspended": bool(learner is not None
+                                  and learner.suspended)}})
             return True
         if "label_of" in job:
             # late ground truth joining an earlier tapped request by

@@ -143,6 +143,29 @@ K_INCUMBENT_ERROR_PCT = _k("incumbent_error_pct")
 K_MARGIN = _k("margin")          #: promote margin the gate holds
 K_TIME_TO_SERVE_MS = _k("time_to_serve_ms")
 
+# -- Gauntlet: the elastic fleet + the degradation ladder --------------
+
+K_LEARNER_CTL = _k("learner_ctl")  #: op=learner_suspend/resume ack
+K_SUSPENDED = _k("suspended")    #: learner_ctl: the learner's state
+K_DEGRADED = _k("degraded")      #: shed carried a ladder rung's mark
+K_RETIRING = _k("retiring")      #: fleet row: replica is draining out
+K_N_REPLICAS = _k("n_replicas")  #: fleet status: live member count
+K_HEDGING_ENABLED = _k("hedging_enabled")  #: ladder rung 2 lever
+K_SHED_TAIL = _k("shed_tail")    #: ladder rung 3 lever
+K_WARM_DIRS = _k("warm_dirs")    #: retained install dirs for respawn
+
+# -- Gauntlet: the traffic trace file (veles-traffic-v1 JSONL) ---------
+# one header line {format, spec, n} then one line per arrival — the
+# byte-identical replay contract shares the wire-key registry
+
+K_I = _k("i")                    #: arrival index (dense, 0-based)
+K_T = _k("t")                    #: scheduled offset secs from day start
+K_ROW_SEED = _k("row_seed")      #: per-arrival input row generator seed
+K_BURST = _k("burst")            #: arrival fell in a burst window
+K_FORMAT = _k("format")          #: trace header: format tag
+K_SPEC = _k("spec")              #: trace header: TrafficSpec dict
+K_N = _k("n")                    #: trace header: arrival count
+
 
 def known(key: str) -> bool:
     """Is ``key`` a declared wire-protocol field?"""

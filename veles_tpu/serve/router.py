@@ -168,15 +168,24 @@ class FleetRouter(Logger):
                 return int(mesh.get(i, 0))
             return int(mesh)
 
-        self.replicas = [
-            Replica(i, self.models, backend=backend,
-                    max_batch=max_batch, max_wait_ms=max_wait_ms,
-                    hbm_budget=hbm_budget,
-                    heartbeat_every=heartbeat_every,
-                    metrics_dir=metrics_dir, cwd=cwd,
-                    env=_replica_env(i), mesh=_replica_mesh(i),
-                    start_timeout=start_timeout)
-            for i in range(self.n_replicas)]
+        def _make_replica(i: int,
+                          install_dir: Optional[str] = None) -> Replica:
+            return Replica(i, self.models, backend=backend,
+                           max_batch=max_batch,
+                           max_wait_ms=max_wait_ms,
+                           hbm_budget=hbm_budget,
+                           heartbeat_every=heartbeat_every,
+                           metrics_dir=metrics_dir, cwd=cwd,
+                           env=_replica_env(i), mesh=_replica_mesh(i),
+                           start_timeout=start_timeout,
+                           install_dir=install_dir)
+
+        #: the scale-up path re-uses the ctor's replica recipe (same
+        #: models/env/backend), so an elastic member is
+        #: indistinguishable from a founding one
+        self._make_replica = _make_replica
+        self.replicas = [_make_replica(i)
+                         for i in range(self.n_replicas)]
         self.fleet = ReplicaSet(
             self.replicas, heartbeat_deadline=heartbeat_deadline,
             respawn_backoff=respawn_backoff)
@@ -198,6 +207,22 @@ class FleetRouter(Logger):
         self._routed = [0] * self.n_replicas
         self._mirror_acc: Dict[str, float] = {}
         self._closed = False
+        # -- elastic-fleet state (Gauntlet) ---------------------------
+        #: next replica index to mint: indices are NEVER reused, so
+        #: ``_routed``/telemetry rows stay unambiguous across the day
+        self._next_idx = self.n_replicas
+        #: install dirs of retired replicas — a scale-up pops one so
+        #: the package unpack (and compile cache) stays warm
+        self._warm_dirs: List[str] = []
+        #: the replicated hot prefix at placement time: a scale-up
+        #: joins these; everything else is the sheddable long tail
+        self._hot_models = {
+            m for m, placed in self.placement.items()
+            if len(placed) == self.n_replicas}
+        #: degradation-ladder levers (the autoscaler flips these via
+        #: ``apply_degradation``; mutable for tests/operators too)
+        self.hedging_enabled = True
+        self.shed_tail = False
         #: gray-failure defense: health scoring, hedging governor,
         #: ejection + probe/reinstate lifecycle
         sentinel_kw = {}
@@ -234,7 +259,8 @@ class FleetRouter(Logger):
         reach it until it is reinstated."""
         placed = set(self.placement.get(model, ()))
         healthy = [r for r in self.fleet.healthy()
-                   if r not in exclude and self.sentinel.eligible(r)]
+                   if r not in exclude and not r.retiring
+                   and self.sentinel.eligible(r)]
         candidates = [r for r in healthy if r.idx in placed] \
             or healthy
         if not candidates:
@@ -317,6 +343,12 @@ class FleetRouter(Logger):
 
     def _dispatch(self, model: str, rows: Any,
                   deadline_ms: float) -> Dict[str, Any]:
+        if self.shed_tail and model not in self._hot_models:
+            # the ladder's last rung: the long tail is explicitly shed
+            # so the hot prefix keeps its p99 — an honest overloaded
+            # response, never a timeout and never a 404
+            return {"error": "overloaded", "overloaded": True,
+                    "degraded": True, "model": model}
         r = self._pick(model)
         if r is None:
             return {"error": "no healthy replica", "model": model}
@@ -468,7 +500,7 @@ class FleetRouter(Logger):
             leg_event(primary, pctx, "timeout", t_leg0)
             return timeout_resp()
         peer: Optional[Replica] = None
-        if self.sentinel.hedge_budget > 0:
+        if self.hedging_enabled and self.sentinel.hedge_budget > 0:
             cand = self._pick(model, exclude=tried + (primary,))
             # a hedge duplicates load: it must pass the SAME admission
             # gate a fresh request would — hedging fights tail
@@ -704,6 +736,169 @@ class FleetRouter(Logger):
 
             r.client.collect_async(jid, _collect)
 
+    # -- elastic scaling (Gauntlet) ------------------------------------
+
+    def add_replica(self, cause: str = "manual",
+                    **info: Any) -> Optional[Replica]:
+        """Scale up by one replica: spawn (into a warm install dir
+        from a retired peer when one is pooled), join it into the hot
+        placement, and hand it to the monitor + sentinel.  The spawn
+        runs on the caller's thread (the autoscaler's loop) — routing
+        never sees the replica until its hello arrived.  Returns the
+        new Replica, or None when the spawn failed (the controller's
+        cooldown spaces the retry)."""
+        with self._lock:
+            idx = self._next_idx
+            self._next_idx += 1
+            warm = self._warm_dirs.pop() if self._warm_dirs else None
+        r = self._make_replica(idx, install_dir=warm)
+        try:
+            hello = r.spawn()
+        except Exception as e:  # noqa: BLE001 — a failed scale-up
+            self.error("scale-up replica %d failed to spawn: %s: %s",
+                       idx, type(e).__name__, e)
+            try:
+                r.close(kill=True)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            return None
+        with self._lock:
+            while len(self._routed) <= idx:
+                self._routed.append(0)
+            # the new member serves the replicated hot prefix; the
+            # partitioned tail keeps its existing owners
+            for m in self._hot_models:
+                placed = self.placement.get(m)
+                if placed is not None and idx not in placed:
+                    placed.append(idx)
+        # health record BEFORE routing can see it (fleet.add puts it
+        # in the shared replicas list every picker iterates)
+        self.sentinel.add_replica(r)
+        self.fleet.add(r)
+        telemetry.counter(events.CTR_FLEET_SCALE_UPS).inc()
+        telemetry.event(events.EV_FLEET_REPLICA_SPAWNED,
+                        replica=idx, pid=hello.get("pid"),
+                        models=sorted(r.models))
+        telemetry.event(events.EV_FLEET_SCALE_UP, replica=idx,
+                        pid=hello.get("pid"), cause=cause,
+                        warm_dir=warm is not None,
+                        n_replicas=len(self.replicas), **info)
+        telemetry.event(events.EV_FLEET_PLACEMENT,
+                        placement=self.placement)
+        self.info("scale-up: replica %d (pid %s, %s install dir) — "
+                  "fleet now %d", idx, hello.get("pid"),
+                  "warm" if warm else "cold", len(self.replicas))
+        return r
+
+    def retire_replica(self, cause: str = "manual",
+                       drain_timeout: float = 30.0,
+                       **info: Any) -> Optional[int]:
+        """Scale down by one replica, in the only safe order:
+
+        1. mark it ``retiring`` — routing and the hedge/mirror picks
+           exclude it immediately, the monitor stops supervising it;
+        2. re-place its EXCLUSIVE models onto a survivor (every
+           replica spawns with the full model set, so the survivor
+           LRU-loads on first request — a shrunk fleet can never 404
+           a tail model);
+        3. drain its router-side in-flight queue (the requests it
+           already accepted finish normally);
+        4. only THEN remove it from supervision and SIGTERM it — the
+           hive's graceful-stop path drains its batcher, dumps the
+           flight recorder, and exits 14;
+        5. pool its install dir for the next scale-up.
+
+        Returns the retired replica's idx, or None when the fleet has
+        no retirable member (a lone or all-unhealthy fleet)."""
+        with self._lock:
+            # the youngest healthy member retires: founding replicas
+            # carry the longest stats history (and any CLI pins)
+            cands = [r for r in self.replicas
+                     if not r.retiring and r.healthy]
+            if len(cands) < 2:
+                return None
+            victim = max(cands, key=lambda r: r.idx)
+            victim.retiring = True
+        survivors = [r for r in self.replicas
+                     if r is not victim and not r.retiring]
+        with self._lock:
+            replaced = []
+            for m, placed in self.placement.items():
+                kept = [i for i in placed if i != victim.idx]
+                if not kept:
+                    # exclusive model: hand it to the least-loaded
+                    # live survivor BEFORE any traffic can miss it
+                    tgt = min(
+                        (r for r in survivors if r.healthy),
+                        key=lambda r: (r.inflight, r.idx),
+                        default=survivors[0])
+                    kept = [tgt.idx]
+                    replaced.append((m, tgt.idx))
+                self.placement[m] = kept
+        if replaced:
+            telemetry.event(events.EV_FLEET_PLACEMENT,
+                            placement=self.placement)
+            self.info("retire: re-placed exclusive models %s off "
+                      "replica %d", replaced, victim.idx)
+        # drain what it already accepted — new work stopped at step 1
+        deadline = time.monotonic() + drain_timeout
+        while victim.inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        drained = victim.inflight == 0
+        self.fleet.remove(victim)
+        self.sentinel.remove_replica(victim)
+        rc = None
+        if victim.client is not None and victim.alive:
+            victim.client.sigterm()
+            try:
+                rc = victim.client.wait(timeout=30.0)
+            except Exception:  # noqa: BLE001 — stuck in its drain
+                pass
+        victim.close(kill=True)   # idempotent reap
+        with self._lock:
+            self._warm_dirs.append(victim.install_dir)
+        telemetry.counter(events.CTR_FLEET_SCALE_DOWNS).inc()
+        telemetry.counter(events.CTR_FLEET_RETIRED).inc()
+        telemetry.event(events.EV_FLEET_REPLICA_RETIRED,
+                        replica=victim.idx, rc=rc, drained=drained,
+                        replaced=[m for m, _ in replaced])
+        telemetry.event(events.EV_FLEET_SCALE_DOWN,
+                        replica=victim.idx, cause=cause, rc=rc,
+                        n_replicas=len(self.replicas), **info)
+        self.info("scale-down: replica %d retired (drained=%s, "
+                  "rc=%s) — fleet now %d", victim.idx, drained, rc,
+                  len(self.replicas))
+        return victim.idx
+
+    def apply_degradation(self, rung: str, engage: bool,
+                          cause: str = "manual",
+                          **info: Any) -> None:
+        """Flip one ladder rung's lever.  ``learner`` fans the
+        suspend/resume op to every live replica; ``hedge`` gates the
+        hedged-request path; ``shed_tail`` sheds non-hot models with
+        an explicit degraded/overloaded response."""
+        if rung == "learner":
+            for r in list(self.replicas):
+                if not r.healthy or r.client is None or r.retiring:
+                    continue
+                try:
+                    r.client.learner_ctl(engage, timeout=10.0)
+                except Exception as e:  # noqa: BLE001 — a replica
+                    # mid-respawn just misses the rung; the monitor's
+                    # respawn spawns with the learner in default state
+                    self.warning("learner_ctl(%s) failed on replica "
+                                 "%d: %s", engage, r.idx, e)
+        elif rung == "hedge":
+            self.hedging_enabled = not engage
+        elif rung == "shed_tail":
+            self.shed_tail = engage
+        else:
+            raise ValueError(f"unknown degradation rung {rung!r}")
+        telemetry.event(
+            events.EV_FLEET_DEGRADE_ENGAGE if engage
+            else events.EV_FLEET_DEGRADE_RELEASE,
+            rung=rung, cause=cause, **info)
+
     # -- introspection -------------------------------------------------
 
     def routed_counts(self) -> List[int]:
@@ -712,14 +907,14 @@ class FleetRouter(Logger):
             return list(self._routed)
 
     def inflight_total(self) -> int:
-        return sum(r.inflight for r in self.replicas)
+        return sum(r.inflight for r in list(self.replicas))
 
     def replica_stats(self, timeout: float = 30.0) \
             -> List[Optional[Dict[str, Any]]]:
         """Each healthy replica's live telemetry snapshot (None for a
         dead slot) — the bench's per-replica recompile audit."""
         out: List[Optional[Dict[str, Any]]] = []
-        for r in self.replicas:
+        for r in list(self.replicas):
             if r.healthy and r.client is not None:
                 try:
                     out.append(r.client.stats(timeout=timeout))
@@ -745,8 +940,13 @@ class FleetRouter(Logger):
                  "ema_dispatch_ms": round(
                      1000 * r.ema_dispatch_s, 3)
                  if r.ema_dispatch_s else None,
+                 "retiring": r.retiring,
                  "sentinel": self.sentinel.status(r)}
-                for r in self.replicas],
+                for r in list(self.replicas)],
+            "n_replicas": len(self.replicas),
+            "hedging_enabled": self.hedging_enabled,
+            "shed_tail": self.shed_tail,
+            "warm_dirs": len(self._warm_dirs),
             "placement": self.placement,
             "canaries": {c: {"of": p, "fraction": f}
                          for c, (p, f) in self.canaries.items()},

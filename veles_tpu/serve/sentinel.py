@@ -184,6 +184,30 @@ class Sentinel(Logger):
             name="fleet-sentinel-probe")
         self._probe_thread.start()
 
+    # -- elastic membership --------------------------------------------
+
+    def _h(self, idx: int) -> ReplicaHealth:
+        """The idx's health record, created on first sight — MUST be
+        called under ``_lock``.  An elastic fleet adds members after
+        the ctor, and a record the scoring paths race to create must
+        never KeyError a request thread."""
+        h = self.health.get(idx)
+        if h is None:
+            h = self.health[idx] = ReplicaHealth(idx)
+        return h
+
+    def add_replica(self, replica: Any) -> None:
+        """A scale-up joined ``self.replicas`` (the shared list the
+        router mutates); give it a fresh health record."""
+        with self._lock:
+            self.health[replica.idx] = ReplicaHealth(replica.idx)
+
+    def remove_replica(self, replica: Any) -> None:
+        """A scale-down left the fleet: drop its record so the peer
+        latency stats and the ejected count stop seeing a ghost."""
+        with self._lock:
+            self.health.pop(replica.idx, None)
+
     # -- routing-side queries ------------------------------------------
 
     def eligible(self, replica: Any) -> bool:
@@ -191,7 +215,7 @@ class Sentinel(Logger):
         health is the ReplicaSet's call; this is the gray-failure
         overlay.)"""
         with self._lock:
-            return self.health[replica.idx].state == STATE_HEALTHY
+            return self._h(replica.idx).state == STATE_HEALTHY
 
     def ejected_count(self) -> int:
         with self._lock:
@@ -271,7 +295,7 @@ class Sentinel(Logger):
         now = time.monotonic()
         strike = False
         with self._lock:
-            h = self.health[replica.idx]
+            h = self._h(replica.idx)
             h.decayed_score(now)
             h.lat_ema_s = latency_s if h.lat_ema_s is None \
                 else 0.8 * h.lat_ema_s + 0.2 * latency_s
@@ -314,8 +338,8 @@ class Sentinel(Logger):
         deadline is too generous to ever expire."""
         telemetry.counter(events.CTR_FLEET_HEDGE_WINS).inc()
         with self._lock:
-            self.health[winner.idx].hedge_wins += 1
-            self.health[loser.idx].hedge_losses += 1
+            self._h(winner.idx).hedge_wins += 1
+            self._h(loser.idx).hedge_losses += 1
         telemetry.counter(
             f"fleet.replica.{winner.idx}.hedge_wins").inc()
         self._strike(loser, "hedge_loss", WEIGHT_HEDGE_LOSS)
@@ -326,7 +350,7 @@ class Sentinel(Logger):
         now = time.monotonic()
         eject = False
         with self._lock:
-            h = self.health[replica.idx]
+            h = self._h(replica.idx)
             h.strikes[kind] = h.strikes.get(kind, 0) + 1
             strikes = dict(h.strikes)
             score = h.decayed_score(now) + weight
@@ -372,7 +396,7 @@ class Sentinel(Logger):
             if r.idx == replica.idx:
                 continue
             if getattr(r, "healthy", False) \
-                    and self.health[r.idx].state == STATE_HEALTHY:
+                    and self._h(r.idx).state == STATE_HEALTHY:
                 return True
         return False
 
@@ -388,7 +412,7 @@ class Sentinel(Logger):
                 if self._closing:
                     return
                 with self._lock:
-                    h = self.health[r.idx]
+                    h = self._h(r.idx)
                     due = h.state == STATE_EJECTED \
                         and now >= h.next_probe_at \
                         and getattr(r, "healthy", False)
@@ -408,12 +432,12 @@ class Sentinel(Logger):
             # no traffic observed yet — nothing to probe with; retry
             # on the same schedule
             with self._lock:
-                self.health[replica.idx].next_probe_at = \
+                self._h(replica.idx).next_probe_at = \
                     time.monotonic() + self.probe_interval
             return
         model, rows = tpl
         with self._lock:
-            self.health[replica.idx].probing = True
+            self._h(replica.idx).probing = True
         telemetry.counter(events.CTR_FLEET_PROBES).inc()
         try:
             ok, detail = self.probe_fn(replica, model, rows)
@@ -422,7 +446,7 @@ class Sentinel(Logger):
         now = time.monotonic()
         reinstate = False
         with self._lock:
-            h = self.health[replica.idx]
+            h = self._h(replica.idx)
             if ok:
                 h.probe_ok_streak += 1
                 h.probe_backoff_s = self.probe_interval
@@ -469,7 +493,7 @@ class Sentinel(Logger):
         """The operator's why-is-it-out-of-rotation row."""
         now = time.monotonic()
         with self._lock:
-            h = self.health[replica.idx]
+            h = self._h(replica.idx)
             return {
                 "state": h.public_state(),
                 "health_score": round(h.decayed_score(now), 3),

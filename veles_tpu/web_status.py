@@ -103,7 +103,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_metrics_json(self) -> None:
         from veles_tpu.obs import (arbiter_ledger, fleet_model_rows,
-                                   fleet_rows, learner_rows, load_dir)
+                                   fleet_rows, learner_rows, load_dir,
+                                   scale_timeline)
         reg, snaps, journals, events = load_dir(self.metrics_dir)
         merged = reg.snapshot()
         merged["snapshots"] = len(snaps)
@@ -115,7 +116,8 @@ class _Handler(BaseHTTPRequestHandler):
         if replicas:
             merged["fleet"] = {
                 "replicas": replicas,
-                "models": fleet_model_rows(reg, events)}
+                "models": fleet_model_rows(reg, events),
+                "scale_timeline": scale_timeline(self.metrics_dir)}
         learners = learner_rows(reg, events)
         if learners:
             merged["learner"] = learners
